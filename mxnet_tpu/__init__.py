@@ -1,0 +1,63 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability
+surface of Apache MXNet (reference: ZhennanQin/incubator-mxnet ~1.6-dev).
+
+Compute path: JAX/XLA (+Pallas kernels); scaling path: jax.sharding Mesh +
+shard_map collectives over ICI/DCN.  See SURVEY.md at the repo root for the
+reference→TPU design mapping.
+
+Import as ``import mxnet_tpu as mx`` — the namespace mirrors ``mxnet``:
+mx.nd, mx.sym, mx.gluon, mx.autograd, mx.cpu()/mx.gpu()/mx.tpu(), mx.io,
+mx.metric, mx.optimizer, mx.init, mx.random, mx.kv.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+from . import random
+from . import autograd
+
+# Subsystems are imported lazily to keep `import mxnet_tpu` light.
+_LAZY = {
+    "gluon": ".gluon",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "init": ".initializer",
+    "initializer": ".initializer",
+    "lr_scheduler": ".lr_scheduler",
+    "io": ".io",
+    "image": ".image",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "mod": ".module",
+    "module": ".module",
+    "callback": ".callback",
+    "model": ".model",
+    "profiler": ".profiler",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "parallel": ".parallel",
+    "amp": ".amp",
+    "np": ".numpy",
+    "npx": ".numpy_extension",
+    "visualization": ".visualization",
+    "viz": ".visualization",
+    "recordio": ".recordio",
+    "engine": ".engine",
+    "contrib": ".contrib",
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY:
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

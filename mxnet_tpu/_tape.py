@@ -1,0 +1,222 @@
+"""Autograd tape.
+
+Reference design: the imperative runtime records an NNVM node per op while
+``autograd.record()`` is active (src/imperative/imperative.cc:193 RecordOp) and
+builds + runs a backward graph on ``backward()`` (imperative.cc:280).
+
+TPU-native re-design: instead of an NNVM graph replayed through a dependency
+engine, each recorded eager op captures its cotangent function *at record time*
+via ``jax.vjp`` — forward residuals live on-device as part of the vjp closure,
+and ``backward()`` is a reverse topological walk accumulating cotangents with
+``jnp.add``.  This keeps MXNet's define-by-run UX while the actual math is pure
+XLA.  Whole hybridized blocks (CachedOp analog) record as a *single* node whose
+vjp is the jit-compiled backward, mirroring CachedOp::Backward
+(src/imperative/cached_op.cc).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+__all__ = [
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "Node",
+    "record_node",
+    "backward",
+    "mark_variable",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev = _STATE.recording
+    _STATE.recording = flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev = _STATE.training
+    _STATE.training = flag
+    return prev
+
+
+class Node:
+    """One recorded op: inputs (NDArrays), outputs (NDArrays), vjp closure.
+
+    ``vjp_fn(cotangents_tuple) -> tuple(input_cotangents)`` where cotangents
+    align 1:1 with outputs/inputs.  ``None`` cotangents are allowed and mean
+    "no gradient flows here".
+    """
+
+    __slots__ = ("inputs", "outputs", "vjp_fn", "name", "_visited")
+
+    def __init__(self, inputs, outputs, vjp_fn, name=""):
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.vjp_fn = vjp_fn
+        self.name = name
+        self._visited = False
+
+
+def record_node(inputs, outputs, vjp_fn, name="") -> Node:
+    """Attach a new tape node to its output arrays."""
+    node = Node(inputs, outputs, vjp_fn, name)
+    for i, out in enumerate(node.outputs):
+        out._tape_node = node
+        out._tape_index = i
+    return node
+
+
+def mark_variable(arr, grad, grad_req="write"):
+    arr._tape_node = None
+    arr._tape_index = 0
+    arr._grad = grad
+    arr._grad_req = grad_req
+    arr._is_leaf = True
+
+
+def _toposort(roots: Sequence[Any]) -> List[Node]:
+    """Reverse-topological order of tape nodes reachable from root arrays."""
+    order: List[Node] = []
+    seen = set()
+
+    # iterative DFS to survive deep graphs (RNN unrolls)
+    for root in roots:
+        node = getattr(root, "_tape_node", None)
+        if node is None or id(node) in seen:
+            continue
+        stack = [(node, iter(node.inputs))]
+        seen.add(id(node))
+        while stack:
+            cur, it = stack[-1]
+            advanced = False
+            for inp in it:
+                child = getattr(inp, "_tape_node", None)
+                if child is not None and id(child) not in seen:
+                    seen.add(id(child))
+                    stack.append((child, iter(child.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(cur)
+                stack.pop()
+    order.reverse()  # now parents (outputs) before children (inputs)
+    return order
+
+
+def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse accumulation from ``outputs``.
+
+    Populates ``arr._grad`` on every reachable leaf marked via
+    ``mark_variable`` (i.e. ``attach_grad``), honoring grad_req write/add.
+    """
+    import jax.numpy as jnp
+
+    outputs = list(outputs)
+    if head_grads is None:
+        head_grads = [None] * len(outputs)
+    else:
+        head_grads = list(head_grads)
+        if len(head_grads) != len(outputs):
+            raise ValueError("head_grads length mismatch")
+
+    # cotangent accumulator keyed by (id(node), out_index) plus leaves by id(arr)
+    cotan = {}
+
+    def _key(arr):
+        return (id(arr._tape_node), arr._tape_index) if arr._tape_node is not None else ("leaf", id(arr))
+
+    def _acc(key, val):
+        if val is None:
+            return
+        if key in cotan:
+            cotan[key] = jnp.add(cotan[key], val)
+        else:
+            cotan[key] = val
+
+    leaf_by_id = {}
+
+    for out, hg in zip(outputs, head_grads):
+        if getattr(out, "_tape_node", None) is None and not getattr(out, "_is_leaf", False):
+            raise ValueError(
+                "cannot differentiate output: it was not computed inside "
+                "autograd.record() (reference: mxnet.autograd same contract)"
+            )
+        g = hg._data if hasattr(hg, "_data") else hg
+        if g is None:
+            # MXNet defaults the head gradient to ones (autograd.py backward)
+            g = jnp.ones(out.shape, out._data.dtype)
+        _acc(_key(out), g)
+        if getattr(out, "_is_leaf", False):
+            leaf_by_id[id(out)] = out
+
+    order = _toposort(outputs)
+
+    for node in order:
+        out_cts = tuple(cotan.get((id(node), i)) for i in range(len(node.outputs)))
+        if all(c is None for c in out_cts):
+            continue
+        # fill zeros for missing output cotangents (vjp needs full tuple)
+        filled = []
+        for arr, c in zip(node.outputs, out_cts):
+            if c is None:
+                filled.append(jnp.zeros(arr.shape, arr._data.dtype))
+            else:
+                filled.append(c)
+        in_cts = node.vjp_fn(tuple(filled))
+        if len(in_cts) != len(node.inputs):
+            raise RuntimeError(
+                "vjp for %s returned %d cotangents for %d inputs"
+                % (node.name, len(in_cts), len(node.inputs))
+            )
+        for inp, ct in zip(node.inputs, in_cts):
+            if ct is None:
+                continue
+            if getattr(inp, "_is_leaf", False):
+                leaf_by_id[id(inp)] = inp
+                _acc(("leaf", id(inp)), ct)
+            elif getattr(inp, "_tape_node", None) is not None:
+                _acc((id(inp._tape_node), inp._tape_index), ct)
+        if not retain_graph:
+            node.vjp_fn = _freed_vjp(node.name)
+
+    # write grads into leaves
+    for arr in leaf_by_id.values():
+        g = cotan.get(("leaf", id(arr)))
+        if g is None:
+            continue
+        if arr._grad is None:
+            continue  # marked with grad_req='null'
+        if arr._grad_req == "add":
+            arr._grad._data = jnp.add(arr._grad._data, g)
+        else:
+            arr._grad._data = jnp.asarray(g, dtype=arr._grad._data.dtype)
+
+
+def _freed_vjp(name):
+    def _raise(*_):
+        raise RuntimeError(
+            "graph for op %r already freed; pass retain_graph=True to backward() "
+            "to backprop twice" % (name,)
+        )
+
+    return _raise

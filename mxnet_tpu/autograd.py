@@ -1,0 +1,139 @@
+"""Autograd user API — record/pause scopes, backward, grad, custom Function.
+
+Reference: python/mxnet/autograd.py:93-452 over MXAutograd* C API and
+src/imperative/imperative.cc.  See _tape.py for the TPU-native tape design.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+from . import _tape
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+
+is_recording = _tape.is_recording
+is_training = _tape.is_training
+set_recording = _tape.set_recording
+set_training = _tape.set_training
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _tape.set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _tape.set_training(self._enter_train_mode)
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            _tape.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _tape.set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Scope: ops executed inside are taped for backward()."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        _tape.mark_variable(v, g, r)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    _tape.backward(heads, head_grads, retain_graph, train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables (without touching .grad)."""
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    # save/restore existing grad state on the variables
+    saved = [(v._grad, v._grad_req, v._is_leaf) for v in variables]
+    import jax.numpy as jnp
+    for v in variables:
+        if not v._is_leaf:
+            raise ValueError("variables passed to grad() must have attach_grad() "
+                             "called or be marked variables")
+        v._grad = _wrap(jnp.zeros(v.shape, v.dtype))
+        v._grad_req = "write"
+    _tape.backward(heads, head_grads if head_grads is None else list(head_grads),
+                   retain_graph if retain_graph is not None else create_graph,
+                   train_mode)
+    outs = [v._grad for v in variables]
+    for v, (g, r, l) in zip(variables, saved):
+        v._grad, v._grad_req, v._is_leaf = g, r, l
+    return outs
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.Function,
+    python/mxnet/autograd.py:370-452): subclass, implement forward(ctx-less)
+    and backward; gradients flow through the tape."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+        outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        if _tape.is_recording():
+            def vjp_fn(cotangents):
+                gs = self.backward(*[_wrap(c) for c in cotangents])
+                if isinstance(gs, NDArray):
+                    gs = [gs]
+                return tuple(g._data if isinstance(g, NDArray) else g for g in gs)
+            _tape.record_node(nd_inputs, outs, vjp_fn,
+                              name=type(self).__name__)
+        return outputs if multi else outs[0]

@@ -1,0 +1,61 @@
+"""Base utilities: dtype registry, error types, naming helpers.
+
+TPU-native re-design of the dmlc/mshadow dtype plumbing the reference threads
+through ``include/mxnet/base.h`` and ``3rdparty/mshadow/mshadow/base.h``.  Here
+a dtype is simply a numpy/jax dtype; the integer type codes are kept only for
+serialization parity with the reference's NDArray save format
+(/root/reference/src/ndarray/ndarray.cc Save/Load).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "DTYPE_TO_CODE",
+    "CODE_TO_DTYPE",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity with the reference's dmlc::Error)."""
+
+
+# Type codes follow mshadow/base.h kFloat32=0, kFloat64=1, kFloat16=2, kUint8=3,
+# kInt32=4, kInt8=5, kInt64=6  (+ TPU-era addition: bfloat16=12 like MXNet 2.x).
+DTYPE_TO_CODE = {
+    _np.dtype("float32"): 0,
+    _np.dtype("float64"): 1,
+    _np.dtype("float16"): 2,
+    _np.dtype("uint8"): 3,
+    _np.dtype("int32"): 4,
+    _np.dtype("int8"): 5,
+    _np.dtype("int64"): 6,
+    _np.dtype("bool"): 7,
+}
+try:  # bfloat16 is first-class on TPU
+    import ml_dtypes as _ml
+
+    DTYPE_TO_CODE[_np.dtype(_ml.bfloat16)] = 12
+except Exception:  # pragma: no cover
+    pass
+
+CODE_TO_DTYPE = {v: k for k, v in DTYPE_TO_CODE.items()}
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+def dtype_np(dtype):
+    """Normalize a user-provided dtype (str/np.dtype/None) to np.dtype."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        import ml_dtypes
+
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(dtype)

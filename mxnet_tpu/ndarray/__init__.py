@@ -1,0 +1,41 @@
+"""The ``mx.nd`` namespace: NDArray + every registered op as a function.
+
+Reference: python/mxnet/ndarray/ — op functions are code-generated from the
+NNVM registry at import.  Here a module ``__getattr__`` resolves any
+registered op name to an eager dispatcher, so ``nd.relu``, ``nd.FullyConnected``
+and friends exist without codegen.
+"""
+from __future__ import annotations
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
+                      linspace, concat, stack, split, where, save, load,
+                      waitall, from_jax)
+from .. import random  # noqa: F401 — nd.random.* parity
+from ..ops import registry as _registry
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "concat", "stack", "split", "where", "save",
+           "load", "waitall", "random", "from_jax"]
+
+
+def zeros_like(data):
+    return _registry.invoke("zeros_like", data)
+
+
+def ones_like(data):
+    return _registry.invoke("ones_like", data)
+
+
+def __getattr__(name):
+    try:
+        op = _registry.get(name)
+    except AttributeError:
+        raise AttributeError("module 'nd' has no attribute %r" % (name,)) from None
+
+    def fn(*args, **kwargs):
+        kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        return _registry.apply_op(op, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn

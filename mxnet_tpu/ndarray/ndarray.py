@@ -1,0 +1,580 @@
+"""NDArray: the imperative tensor value type.
+
+Reference design: ``include/mxnet/ndarray.h:82`` — a ref-counted chunk of
+device storage plus an engine variable; mutation is ordered by the dependency
+engine; reads block via WaitToRead (ndarray.h:368-377); autograd entry/grad
+hang off the array (AGInfo).
+
+TPU-native re-design: an NDArray is a thin *mutable handle* onto an immutable
+``jax.Array``.  Mutating methods (``+=``, ``x[:]=``, in-place ops) replace the
+underlying buffer (functional update via ``.at[]``), which is exactly how XLA
+wants state expressed; jax's async dispatch supplies the engine's
+compute/compute overlap, and ``wait_to_read`` maps to
+``jax.block_until_ready``.  Autograd state (tape node, grad, grad_req) lives on
+the handle like the reference's AGInfo.  In-place mutation of an array that is
+part of a recorded graph raises, mirroring Imperative::RecordOp's CHECK
+(src/imperative/imperative.cc:193).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import _tape
+from ..base import dtype_np
+from ..context import Context, ctx_from_device, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "concat", "stack", "split", "where", "save", "load",
+           "waitall", "from_jax", "newaxis"]
+
+newaxis = None
+
+
+def _wrap(data, ctx=None):
+    arr = NDArray.__new__(NDArray)
+    arr._init(data)
+    return arr
+
+
+def from_jax(data):
+    """Wrap an existing jax.Array without copy."""
+    return _wrap(jnp.asarray(data))
+
+
+class NDArray:
+    __slots__ = ("_data", "_grad", "_grad_req", "_tape_node", "_tape_index",
+                 "_is_leaf", "__weakref__")
+
+    # numpy should defer to us in mixed expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        val = jnp.asarray(data, dtype=dtype_np(dtype) if dtype is not None else None)
+        if ctx is not None:
+            val = jax.device_put(val, ctx.jax_device)
+        self._init(val)
+
+    def _init(self, data):
+        self._data = data
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_node = None
+        self._tape_index = 0
+        self._is_leaf = False
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return current_context()
+        return ctx_from_device(dev)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            _np.asarray(self._data), "x".join(map(str, self.shape)), self.context)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------ host interchange
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # --------------------------------------------------------- sync / engine
+    def wait_to_read(self):
+        """Block until async compute producing this array finishes
+        (reference: NDArray::WaitToRead, include/mxnet/ndarray.h:368)."""
+        jax.block_until_ready(self._data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    # ------------------------------------------------------------- placement
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return _wrap(jax.device_put(self._data, other.jax_device))
+        if isinstance(other, NDArray):
+            other._check_mutable()
+            other._data = jax.device_put(
+                jnp.asarray(self._data, dtype=other.dtype),
+                next(iter(other._data.devices())))
+            return other
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copy(self):
+        return _wrap(jnp.asarray(self._data))
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer and mark this array as a tape leaf
+        (reference: MXAutogradMarkVariables)."""
+        grad = _wrap(jnp.zeros(self.shape, self.dtype)) if grad_req != "null" else None
+        _tape.mark_variable(self, grad, grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _tape.backward([self], [out_grad], retain_graph, train_mode)
+
+    def detach(self):
+        out = _wrap(self._data)
+        return out
+
+    # ------------------------------------------------------------- mutation
+    def _check_mutable(self):
+        if _tape.is_recording() and (self._tape_node is not None or self._is_leaf):
+            raise RuntimeError(
+                "in-place write to an NDArray that is part of a recorded "
+                "computation graph is forbidden inside autograd.record() "
+                "(reference: Imperative::RecordOp CHECK)")
+
+    def _set_data(self, new_data):
+        self._check_mutable()
+        self._data = new_data
+
+    def __setitem__(self, key, value):
+        self._check_mutable()
+        if isinstance(value, NDArray):
+            value = value._data
+        key = _index_to_jax(key)
+        if key == slice(None) or key == (slice(None),):
+            self._data = jnp.broadcast_to(
+                jnp.asarray(value, dtype=self.dtype), self.shape)
+        else:
+            self._data = self._data.at[key].set(jnp.asarray(value, dtype=self.dtype))
+
+    def __getitem__(self, key):
+        from ..ops.registry import apply_op, get
+        jkey = _index_to_jax(key)
+        return apply_op(get("_slice_index"), self, key=jkey)
+
+    # ------------------------------------------------------------ arithmetic
+    def _binop(self, name, other, reverse=False):
+        from ..ops.registry import invoke
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(name, a, b)
+        a, b = (other, self) if reverse else (self, other)
+        return invoke(name, a, b)
+
+    def __add__(self, o): return self._binop("broadcast_add", o)
+    def __radd__(self, o): return self._binop("broadcast_add", o, True)
+    def __sub__(self, o): return self._binop("broadcast_sub", o)
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o)
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, True)
+    def __truediv__(self, o): return self._binop("broadcast_div", o)
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, True)
+    def __mod__(self, o): return self._binop("broadcast_mod", o)
+    def __rmod__(self, o): return self._binop("broadcast_mod", o, True)
+    def __pow__(self, o): return self._binop("broadcast_power", o)
+    def __rpow__(self, o): return self._binop("broadcast_power", o, True)
+    def __matmul__(self, o): return self._binop("batch_dot_auto", o)
+    def __neg__(self):
+        from ..ops.registry import invoke
+        return invoke("negative", self)
+    def __abs__(self):
+        from ..ops.registry import invoke
+        return invoke("abs", self)
+
+    def __eq__(self, o): return self._binop("broadcast_equal", o)
+    def __ne__(self, o): return self._binop("broadcast_not_equal", o)
+    def __gt__(self, o): return self._binop("broadcast_greater", o)
+    def __ge__(self, o): return self._binop("broadcast_greater_equal", o)
+    def __lt__(self, o): return self._binop("broadcast_lesser", o)
+    def __le__(self, o): return self._binop("broadcast_lesser_equal", o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        self._check_mutable()
+        self._data = jnp.add(self._data, o._data if isinstance(o, NDArray) else o)
+        return self
+
+    def __isub__(self, o):
+        self._check_mutable()
+        self._data = jnp.subtract(self._data, o._data if isinstance(o, NDArray) else o)
+        return self
+
+    def __imul__(self, o):
+        self._check_mutable()
+        self._data = jnp.multiply(self._data, o._data if isinstance(o, NDArray) else o)
+        return self
+
+    def __itruediv__(self, o):
+        self._check_mutable()
+        self._data = jnp.divide(self._data, o._data if isinstance(o, NDArray) else o)
+        return self
+
+    # ------------------------------------------------------------ transforms
+    def _unop(self, name, **attrs):
+        from ..ops.registry import invoke
+        return invoke(name, self, **attrs)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        # MXNet reshape magic: 0 copies input dim, -1 infers
+        out = []
+        for i, s in enumerate(shape):
+            out.append(self.shape[i] if s == 0 else s)
+        return self._unop("reshape", shape=tuple(out))
+
+    def reshape_like(self, other):
+        return self._unop("reshape", shape=other.shape)
+
+    def astype(self, dtype, copy=True):
+        return self._unop("cast", dtype=str(dtype_np(dtype)))
+
+    def transpose(self, *axes, **kwargs):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = kwargs.get("axes", axes)
+        return self._unop("transpose", axes=tuple(axes) if axes else None)
+
+    def swapaxes(self, dim1, dim2):
+        return self._unop("swapaxes", dim1=dim1, dim2=dim2)
+
+    def flatten(self):
+        return self._unop("flatten")
+
+    def expand_dims(self, axis):
+        return self._unop("expand_dims", axis=axis)
+
+    def squeeze(self, axis=None):
+        return self._unop("squeeze", axis=axis)
+
+    def broadcast_to(self, shape):
+        return self._unop("broadcast_to", shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return self._unop("broadcast_to", shape=other.shape)
+
+    def tile(self, reps):
+        return self._unop("tile", reps=tuple(reps) if isinstance(reps, (tuple, list)) else (reps,))
+
+    def repeat(self, repeats, axis=None):
+        return self._unop("repeat", repeats=repeats, axis=axis)
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0):
+        return self._unop("pad", mode=mode, pad_width=tuple(pad_width),
+                          constant_value=constant_value)
+
+    def slice_axis(self, axis, begin, end):
+        return self._unop("slice_axis", axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from ..ops.registry import invoke
+        return invoke("take", self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return self._unop("one_hot", depth=depth, on_value=on_value, off_value=off_value)
+
+    def clip(self, a_min=None, a_max=None):
+        return self._unop("clip", a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return self._unop("abs")
+
+    def sign(self):
+        return self._unop("sign")
+
+    def exp(self):
+        return self._unop("exp")
+
+    def log(self):
+        return self._unop("log")
+
+    def sqrt(self):
+        return self._unop("sqrt")
+
+    def square(self):
+        return self._unop("square")
+
+    def relu(self):
+        return self._unop("relu")
+
+    def sigmoid(self):
+        return self._unop("sigmoid")
+
+    def tanh(self):
+        return self._unop("tanh")
+
+    def softmax(self, axis=-1):
+        return self._unop("softmax", axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return self._unop("log_softmax", axis=axis)
+
+    # ------------------------------------------------------------ reductions
+    def _reduce(self, name, axis=None, keepdims=False, **kw):
+        from ..ops.registry import invoke
+        return invoke(name, self, axis=_norm_axis(axis), keepdims=keepdims, **kw)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._reduce("norm", axis, keepdims, ord=ord)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._reduce("argmax", axis, keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._reduce("argmin", axis, keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._unop("argsort", axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._unop("sort", axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return self._unop("topk", axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        from ..ops.registry import invoke
+        return invoke("dot", self, other, transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+    # sparse-API parity: dense arrays pass through
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import dense_to_sparse
+        return dense_to_sparse(self, stype)
+
+    def as_np_ndarray(self):
+        from ..numpy import ndarray as np_ndarray
+        out = np_ndarray.__new__(np_ndarray)
+        out._init(self._data)
+        return out
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _index_to_jax(key):
+    """Convert NDArray-bearing index expressions to jax-compatible ones."""
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+# --------------------------------------------------------------------------
+# creation functions
+# --------------------------------------------------------------------------
+
+def _ctx_put(val, ctx):
+    if ctx is not None:
+        val = jax.device_put(val, ctx.jax_device)
+    return val
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array._data
+    if dtype is None:
+        # MXNet: dtype defaults to source.dtype for ndarray sources, float32
+        # for python lists/scalars
+        if isinstance(source_array, (_np.ndarray, jax.Array)):
+            dt = source_array.dtype
+            dtype = _np.float32 if dt == _np.float64 else dt
+        else:
+            dtype = _np.float32
+    val = jnp.asarray(source_array, dtype=dtype_np(dtype))
+    return _wrap(_ctx_put(val, ctx))
+
+
+def zeros(shape, ctx=None, dtype=None, **_):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _wrap(_ctx_put(jnp.zeros(shape, dtype_np(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype=None, **_):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _wrap(_ctx_put(jnp.ones(shape, dtype_np(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype=None, **_):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _wrap(_ctx_put(jnp.full(shape, val, dtype_np(dtype)), ctx))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    val = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat > 1:
+        val = jnp.repeat(val, repeat)
+    return _wrap(_ctx_put(val, ctx))
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    val = jnp.eye(N, M if M else N, k, dtype=dtype_np(dtype))
+    return _wrap(_ctx_put(val, ctx))
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    val = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype_np(dtype))
+    return _wrap(_ctx_put(val, ctx))
+
+
+def concat(*data, dim=1):
+    from ..ops.registry import invoke
+    return invoke("concat", *data, dim=dim)
+
+
+def stack(*data, axis=0):
+    from ..ops.registry import invoke
+    return invoke("stack", *data, axis=axis)
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    from ..ops.registry import invoke
+    return invoke("split", data, num_outputs=num_outputs, axis=axis,
+                  squeeze_axis=squeeze_axis)
+
+
+def where(condition, x, y):
+    from ..ops.registry import invoke
+    return invoke("where", condition, x, y)
+
+
+def waitall():
+    """Reference: Engine::WaitForAll via MXNDArrayWaitAll."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# serialization (reference: MXNDArraySave/Load, src/c_api/c_api.cc:360-414)
+# --------------------------------------------------------------------------
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArrays (.npz container)."""
+    if isinstance(data, NDArray):
+        payload, names = [data], ["__mx_single__"]
+    elif isinstance(data, (list, tuple)):
+        payload = list(data)
+        names = ["__mx_list_%d__" % i for i in range(len(payload))]
+    elif isinstance(data, dict):
+        names, payload = zip(*sorted(data.items())) if data else ((), ())
+        names, payload = list(names), list(payload)
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    arrays = {n: p.asnumpy() for n, p in zip(names, payload)}
+    with open(fname, "wb") as f:  # exact filename, no .npz suffix magic
+        _np.savez(f, **arrays)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as zf:
+        names = list(zf.keys())
+        if names == ["__mx_single__"]:
+            return array(zf["__mx_single__"])
+        if all(n.startswith("__mx_list_") for n in names):
+            return [array(zf["__mx_list_%d__" % i]) for i in range(len(names))]
+        return {n: array(zf[n]) for n in names}

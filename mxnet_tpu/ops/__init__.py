@@ -1,0 +1,6 @@
+"""Operator registry + lowering library (see registry.py for the design)."""
+from .registry import Operator, apply_op, get, invoke, list_ops, register
+from . import tensor  # noqa: F401  (registers tensor ops)
+from . import nn  # noqa: F401  (registers nn ops)
+
+__all__ = ["Operator", "apply_op", "get", "invoke", "list_ops", "register"]

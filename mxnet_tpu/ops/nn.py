@@ -1,0 +1,374 @@
+"""Neural-network ops.
+
+Reference: src/operator/nn/ (fully_connected.cc:255-322, convolution-inl.h,
+batch_norm.cc, pooling.cc, softmax, dropout, layer_norm ...; cuDNN/MKLDNN
+kernel dispatch).  TPU-native: each op is a single jax/lax lowering — conv and
+FC map straight onto the MXU via lax.conv_general_dilated / jnp.dot, norms and
+activations are VPU elementwise code that XLA fuses into neighbors.  The NCHW
+default layout of the reference API is preserved at the op boundary; XLA's
+layout assignment re-tiles internally for the MXU, so no NHWC rewrite is
+forced on users.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ------------------------------------------------------------------ dense
+
+@register("FullyConnected", aliases=("fully_connected",))
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True, **_):
+    x = jnp.asarray(data)
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    out = jnp.dot(x, jnp.asarray(weight).T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ------------------------------------------------------------------ conv
+
+def _conv_dims(ndim):
+    # spatial rank -> (lhs, rhs, out) layout strings, NC-first like reference
+    spatial = "DHW"[-ndim:]
+    return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + (t[-1],) * (n - len(t))
+
+
+@register("Convolution", aliases=("convolution",))
+def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                 pad=None, num_filter=None, num_group=1, no_bias=False,
+                 layout=None, **_):
+    x = jnp.asarray(data)
+    w = jnp.asarray(weight)
+    ndim = x.ndim - 2
+    stride = _tup(stride, ndim)
+    dilate = _tup(dilate, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    pad = pad if isinstance(pad[0], tuple) else tuple((p, p) for p in pad)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    out = out.astype(x.dtype)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                   dilate=None, pad=None, adj=None, num_filter=None,
+                   num_group=1, no_bias=True, **_):
+    x = jnp.asarray(data)
+    w = jnp.asarray(weight)  # (C_in, C_out/g, *k) — reference layout
+    ndim = x.ndim - 2
+    stride = _tup(stride, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    k = w.shape[2:]
+    # transposed conv = gradient of conv: use conv_general_dilated with
+    # lhs_dilation=stride and flipped kernel
+    wt = jnp.swapaxes(w, 0, 1)  # (C_out/g, C_in, *k)
+    wt = jnp.flip(wt, axis=tuple(range(2, 2 + ndim)))
+    pads = tuple((k[i] - 1 - pad[i], k[i] - 1 - pad[i] + (adj[i] if adj else 0))
+                 for i in range(ndim))
+    dn = lax.conv_dimension_numbers(x.shape, wt.shape, _conv_dims(ndim))
+    out = lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * ndim, padding=pads,
+        lhs_dilation=stride, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+# ------------------------------------------------------------------ pooling
+
+@register("Pooling", aliases=("pooling",))
+def _pooling(data, kernel=None, pool_type="max", global_pool=False,
+             stride=None, pad=None, pooling_convention="valid",
+             count_include_pad=True, **_):
+    x = jnp.asarray(data)
+    ndim = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    kernel = _tup(kernel, ndim)
+    stride = _tup(stride if stride is not None else kernel, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        p = 2.0
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pads)
+        return s ** (1.0 / p)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ------------------------------------------------------------------ norms
+
+@register("BatchNorm", aliases=("batch_norm",), num_outputs=3)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                axis=1, training=False, **_):
+    """Returns (out, batch_mean, batch_var).  Moving-stat update is done by
+    the caller (gluon BatchNorm layer) — pure-functional split of the
+    reference's in-op aux-state mutation (src/operator/nn/batch_norm.cc)."""
+    x = jnp.asarray(data)
+    g = jnp.asarray(gamma)
+    if fix_gamma:
+        g = jnp.ones_like(g)
+    red_axes = tuple(i for i in range(x.ndim) if i != axis)
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=red_axes)
+        var = jnp.var(x, axis=red_axes)
+    else:
+        mean = jnp.asarray(moving_mean)
+        var = jnp.asarray(moving_var)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = lax.rsqrt(var + eps).reshape(shape)
+    out = (x - mean.reshape(shape)) * inv * g.reshape(shape) + jnp.asarray(beta).reshape(shape)
+    return out, mean, var
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **_):
+    x = jnp.asarray(data)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return out * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **_):
+    x = jnp.asarray(data)  # (N, C, ...)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xn = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    return xn * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def _instance_norm(data, gamma, beta, eps=1e-3, **_):
+    x = jnp.asarray(data)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xn = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return xn * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+
+
+# ------------------------------------------------------------------ softmax
+
+@register("softmax")
+def _softmax(data, axis=-1, temperature=None, length=None, **_):
+    x = jnp.asarray(data)
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = steps.reshape(shape) < jnp.expand_dims(jnp.asarray(length), axis)
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, **_):
+    x = jnp.asarray(data)
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def _softmin(data, axis=-1, **_):
+    return jax.nn.softmax(-jnp.asarray(data), axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization):
+    x = jnp.asarray(data)
+    return jax.nn.softmax(x, axis=-1 if not multi_output else 1)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _smo_fwd(data, label):
+    p = jax.nn.softmax(data, axis=-1)
+    return p, (p, label)
+
+
+def _smo_bwd(res, g):
+    # reference semantics: gradient is (p - onehot(label)), independent of the
+    # incoming cotangent (SoftmaxOutput defines its own loss;
+    # src/operator/softmax_output-inl.h)
+    p, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+    return ((p - onehot) / p.shape[0], jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    use_ignore=False, multi_output=False,
+                    normalization="batch", **_):
+    return _softmax_output_core(jnp.asarray(data), jnp.asarray(label))
+
+
+# ------------------------------------------------------------------ act
+
+@register("Activation", aliases=("activation",))
+def _activation(data, act_type="relu", **_):
+    x = jnp.asarray(data)
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", aliases=("leaky_relu",))
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, **_):
+    x = jnp.asarray(data)
+    if act_type == "leaky":
+        return jax.nn.leaky_relu(x, slope)
+    if act_type == "prelu":
+        g = jnp.asarray(gamma)
+        shape = (1, -1) + (1,) * (x.ndim - 2) if x.ndim > 1 else (-1,)
+        return jnp.where(x >= 0, x, g.reshape(shape) * x)
+    if act_type == "elu":
+        return jax.nn.elu(x, slope)
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        return jax.nn.leaky_relu(x, (lower_bound + upper_bound) / 2.0)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+# ------------------------------------------------------------------ dropout
+
+@register("Dropout", aliases=("dropout",))
+def _dropout(data, p=0.5, mode="training", axes=(), training=False, **_):
+    x = jnp.asarray(data)
+    if not training and mode != "always":
+        return x
+    if p <= 0.0:
+        return x
+    from ..random import next_key
+    shape = list(x.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(next_key(), keep, tuple(shape))
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+# ------------------------------------------------------------------ losses
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **_):
+    """data: (T, B, V) activations (pre-softmax); label: (B, L) padded with -1
+    or 0.  Reference: src/operator/nn/ctc_loss.cc.  TPU lowering via optax."""
+    import optax
+    x = jnp.transpose(jnp.asarray(data), (1, 0, 2))  # (B, T, V)
+    lab = jnp.asarray(label).astype(jnp.int32)
+    B, T, V = x.shape
+    if use_data_lengths and data_lengths is not None:
+        dl = jnp.asarray(data_lengths).astype(jnp.int32)
+        logitpad = (jnp.arange(T)[None, :] >= dl[:, None]).astype(x.dtype)
+    else:
+        logitpad = jnp.zeros((B, T), x.dtype)
+    if use_label_lengths and label_lengths is not None:
+        ll = jnp.asarray(label_lengths).astype(jnp.int32)
+        labpad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]).astype(x.dtype)
+    else:
+        labpad = (lab < 0).astype(x.dtype) if blank_label == "first" else (lab <= 0).astype(x.dtype)
+    if blank_label == "first":
+        # optax uses blank=0 like the reference's default
+        pass
+    lab = jnp.maximum(lab, 0)
+    return optax.ctc_loss(x, logitpad, lab, labpad)
+
+
+# ------------------------------------------------------------------ misc
+
+@register("BlockGrad", aliases=("stop_gradient", "block_grad"))
+def _block_grad(data, **_):
+    return lax.stop_gradient(jnp.asarray(data))
+
+
+@register("identity", aliases=("_copy",))
+def _identity(data, **_):
+    return jnp.asarray(data)
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def _make_loss(data, grad_scale=1.0, **_):
+    return jnp.asarray(data) * 1.0
+
+
+@register("UpSampling", aliases=("upsampling",))
+def _upsampling(data, scale=2, sample_type="nearest", **_):
+    x = jnp.asarray(data)
+    out = jnp.repeat(jnp.repeat(x, scale, axis=-2), scale, axis=-1)
+    return out

@@ -1,0 +1,132 @@
+"""Operator registry and eager dispatcher.
+
+Reference design: NNVM op registry with per-op attributes
+(FInferShape/FInferType/FCompute..., include/mxnet/op_attr_types.h:217-282) and
+the imperative dispatcher Imperative::Invoke → PushFCompute
+(src/imperative/imperative_utils.h:395) pushing kernels to the ThreadedEngine.
+
+TPU-native re-design: an op is a *pure jax function* plus metadata.  Eager
+dispatch is a direct call — jax's async dispatch replaces the engine — and
+differentiability comes from taping a ``jax.vjp`` at call time instead of an
+FGradient graph pass.  The same pure functions serve the Symbol executor and
+hybridized (jit) paths, so there is exactly one lowering per op.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as _np
+
+__all__ = ["Operator", "register", "get", "apply_op", "list_ops"]
+
+_REGISTRY: Dict[str, "Operator"] = {}
+
+
+class Operator:
+    """Metadata wrapper for a registered op.
+
+    Parameters
+    ----------
+    name : canonical op name (reference NNVM name where one exists).
+    fn : pure function ``fn(*arrays, **attrs) -> array | tuple(arrays)``.
+    differentiable : False for ops with no gradient (argmax, comparisons...).
+    num_outputs : static output count (informational).
+    aliases : extra registry names.
+    """
+
+    __slots__ = ("name", "fn", "differentiable", "num_outputs")
+
+    def __init__(self, name, fn, differentiable=True, num_outputs=1):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.num_outputs = num_outputs
+
+
+def register(name, differentiable=True, num_outputs=1, aliases=()):
+    """Decorator: register a pure jax function as an op."""
+
+    def deco(fn):
+        op = Operator(name, fn, differentiable, num_outputs)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise AttributeError("operator %r is not registered" % (name,)) from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+def _float0_to_none(ct):
+    if ct is None:
+        return None
+    if getattr(ct, "dtype", None) == jax.dtypes.float0:
+        return None
+    return ct
+
+
+def apply_op(op, *inputs, **attrs):
+    """Eager-execute ``op`` on NDArray inputs, taping a vjp when recording.
+
+    Returns NDArray or list of NDArrays (matching the op's output arity).
+    """
+    from .. import _tape
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    if isinstance(op, str):
+        op = get(op)
+
+    in_arrays = []
+    nd_inputs = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            nd_inputs.append(x)
+            in_arrays.append(x._data)
+        else:
+            in_arrays.append(x)
+
+    recording = _tape.is_recording() and op.differentiable and nd_inputs
+
+    if recording:
+        nd_idx = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+
+        def pure(*diff_arrays):
+            full = list(in_arrays)
+            for i, a in zip(nd_idx, diff_arrays):
+                full[i] = a
+            return op.fn(*full, **attrs)
+
+        diff_in = [in_arrays[i] for i in nd_idx]
+        out_vals, vjp = jax.vjp(pure, *diff_in)
+        multi = isinstance(out_vals, (tuple, list))
+        outs = [_wrap(v) for v in (out_vals if multi else (out_vals,))]
+
+        def vjp_fn(cotangents, _vjp=vjp, _multi=multi):
+            cts = tuple(cotangents) if _multi else cotangents[0]
+            in_cts = _vjp(cts)
+            return tuple(_float0_to_none(c) for c in in_cts)
+
+        _tape.record_node(nd_inputs, outs, vjp_fn, name=op.name)
+        return outs if multi else outs[0]
+
+    out_vals = op.fn(*in_arrays, **attrs)
+    if isinstance(out_vals, (tuple, list)):
+        return [_wrap(v) for v in out_vals]
+    return _wrap(out_vals)
+
+
+def invoke(name, *inputs, **attrs):
+    """Convenience: apply by name (used by generated NDArray methods)."""
+    return apply_op(get(name), *inputs, **attrs)

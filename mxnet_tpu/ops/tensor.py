@@ -1,0 +1,547 @@
+"""Tensor ops: elementwise, broadcast, reductions, indexing, linalg.
+
+Reference: src/operator/tensor/ (31.2 kLoC of mshadow/CUDA kernels,
+elemwise_binary_broadcast_op-inl.h, matrix_op-inl.h, ordering_op-inl.h ...).
+TPU-native: every op is one pure jax.numpy/lax lowering; XLA fuses elementwise
+chains into single kernels, so there is no hand-written kernel layer at all.
+Names follow the reference's NNVM registry where a counterpart exists.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------- arithmetic
+
+def _bin(name, fn, aliases=()):
+    register(name, aliases=aliases)(lambda a, b, **_: fn(jnp.asarray(a), jnp.asarray(b)))
+
+
+_bin("broadcast_add", jnp.add, aliases=("elemwise_add", "_plus", "add"))
+_bin("broadcast_sub", jnp.subtract, aliases=("elemwise_sub", "_minus", "subtract"))
+_bin("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "multiply"))
+_bin("broadcast_div", jnp.divide, aliases=("elemwise_div", "divide"))
+_bin("broadcast_mod", jnp.mod, aliases=("mod",))
+_bin("broadcast_power", jnp.power, aliases=("power", "_power"))
+_bin("broadcast_maximum", jnp.maximum, aliases=("maximum",))
+_bin("broadcast_minimum", jnp.minimum, aliases=("minimum",))
+_bin("broadcast_hypot", jnp.hypot, aliases=("hypot",))
+_bin("arctan2", jnp.arctan2, aliases=("broadcast_arctan2",))
+
+
+def _cmp(name, fn, aliases=()):
+    @register(name, differentiable=False, aliases=aliases)
+    def _op(a, b, _fn=fn, **_):
+        return _fn(jnp.asarray(a), jnp.asarray(b)).astype(jnp.float32)
+
+
+_cmp("broadcast_equal", jnp.equal, aliases=("_equal",))
+_cmp("broadcast_not_equal", jnp.not_equal, aliases=("_not_equal",))
+_cmp("broadcast_greater", jnp.greater, aliases=("_greater",))
+_cmp("broadcast_greater_equal", jnp.greater_equal, aliases=("_greater_equal",))
+_cmp("broadcast_lesser", jnp.less, aliases=("_lesser",))
+_cmp("broadcast_lesser_equal", jnp.less_equal, aliases=("_lesser_equal",))
+_cmp("broadcast_logical_and", jnp.logical_and, aliases=("logical_and",))
+_cmp("broadcast_logical_or", jnp.logical_or, aliases=("logical_or",))
+_cmp("broadcast_logical_xor", jnp.logical_xor, aliases=("logical_xor",))
+
+
+# ---------------------------------------------------------------- unary math
+
+def _un(name, fn, aliases=(), differentiable=True):
+    register(name, aliases=aliases, differentiable=differentiable)(
+        lambda a, _fn=fn, **_: _fn(jnp.asarray(a)))
+
+
+_un("negative", jnp.negative)
+_un("abs", jnp.abs)
+_un("sign", jnp.sign)
+_un("rint", jnp.rint, differentiable=False)
+_un("ceil", jnp.ceil, differentiable=False)
+_un("floor", jnp.floor, differentiable=False)
+_un("trunc", jnp.trunc, differentiable=False)
+_un("round", jnp.round, differentiable=False)
+_un("exp", jnp.exp)
+_un("expm1", jnp.expm1)
+_un("log", jnp.log)
+_un("log10", jnp.log10)
+_un("log2", jnp.log2)
+_un("log1p", jnp.log1p)
+_un("sqrt", jnp.sqrt)
+_un("rsqrt", lambda a: lax.rsqrt(a))
+_un("cbrt", jnp.cbrt)
+_un("rcbrt", lambda a: 1.0 / jnp.cbrt(a))
+_un("square", jnp.square)
+_un("reciprocal", jnp.reciprocal)
+_un("sin", jnp.sin)
+_un("cos", jnp.cos)
+_un("tan", jnp.tan)
+_un("arcsin", jnp.arcsin)
+_un("arccos", jnp.arccos)
+_un("arctan", jnp.arctan)
+_un("sinh", jnp.sinh)
+_un("cosh", jnp.cosh)
+_un("tanh", jnp.tanh)
+_un("arcsinh", jnp.arcsinh)
+_un("arccosh", jnp.arccosh)
+_un("arctanh", jnp.arctanh)
+_un("degrees", jnp.degrees)
+_un("radians", jnp.radians)
+_un("sigmoid", jax.nn.sigmoid)
+_un("softsign", jax.nn.soft_sign)
+_un("relu", jax.nn.relu)
+_un("erf", jax.scipy.special.erf)
+_un("erfinv", jax.scipy.special.erfinv)
+_un("gamma", lambda a: jnp.exp(jax.scipy.special.gammaln(a)))
+_un("gammaln", jax.scipy.special.gammaln)
+_un("logical_not", lambda a: jnp.logical_not(a).astype(jnp.float32),
+    differentiable=False)
+_un("isnan", lambda a: jnp.isnan(a).astype(jnp.float32), differentiable=False)
+_un("isinf", lambda a: jnp.isinf(a).astype(jnp.float32), differentiable=False)
+_un("isfinite", lambda a: jnp.isfinite(a).astype(jnp.float32), differentiable=False)
+
+
+@register("clip")
+def _clip(a, a_min=None, a_max=None, **_):
+    return jnp.clip(a, a_min, a_max)
+
+
+@register("cast", aliases=("Cast",))
+def _cast(a, dtype="float32", **_):
+    from ..base import dtype_np
+    return jnp.asarray(a, dtype=dtype_np(dtype))
+
+
+@register("smooth_l1")
+def _smooth_l1(a, scalar=1.0, **_):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(a) < 1.0 / s2, 0.5 * s2 * a * a,
+                     jnp.abs(a) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------- reductions
+
+def _red(name, fn, aliases=(), differentiable=True):
+    @register(name, aliases=aliases, differentiable=differentiable)
+    def _op(a, axis=None, keepdims=False, _fn=fn, **kw):
+        return _fn(jnp.asarray(a), axis=axis, keepdims=keepdims)
+
+
+_red("sum", jnp.sum, aliases=("sum_axis",))
+_red("mean", jnp.mean)
+_red("prod", jnp.prod)
+_red("nansum", jnp.nansum)
+_red("nanprod", jnp.nanprod)
+_red("max", jnp.max, aliases=("max_axis",))
+_red("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def _norm(a, axis=None, keepdims=False, ord=2, **_):
+    if ord == 1:
+        return jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def _argmax(a, axis=None, keepdims=False, **_):
+    out = jnp.argmax(a, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def _argmin(a, axis=None, keepdims=False, **_):
+    out = jnp.argmin(a, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("logsumexp")
+def _logsumexp(a, axis=None, keepdims=False, **_):
+    return jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------- shape ops
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(a, shape=None, **_):
+    return jnp.reshape(a, shape)
+
+
+@register("transpose")
+def _transpose(a, axes=None, **_):
+    return jnp.transpose(a, axes if axes else None)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(a, dim1=0, dim2=0, **_):
+    return jnp.swapaxes(a, dim1, dim2)
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(a, **_):
+    return jnp.reshape(a, (a.shape[0], -1))
+
+
+@register("expand_dims")
+def _expand_dims(a, axis=0, **_):
+    return jnp.expand_dims(a, axis)
+
+
+@register("squeeze")
+def _squeeze(a, axis=None, **_):
+    return jnp.squeeze(a, axis)
+
+
+@register("broadcast_to")
+def _broadcast_to(a, shape=None, **_):
+    # MXNet semantics: 0 in target shape keeps the source dim
+    tgt = tuple(s if s != 0 else a.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(a, tgt)
+
+
+@register("broadcast_axis")
+def _broadcast_axis(a, axis=(), size=(), **_):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(a.shape)
+    for ax, s in zip(axes, sizes):
+        tgt[ax] = s
+    return jnp.broadcast_to(a, tuple(tgt))
+
+
+@register("tile")
+def _tile(a, reps=(), **_):
+    return jnp.tile(a, reps)
+
+
+@register("repeat")
+def _repeat(a, repeats=1, axis=None, **_):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(a, pad_width=None, mode="constant", constant_value=0.0, **_):
+    pw = list(pad_width)
+    # reference uses flat 2N tuple (mshadow style); accept both
+    if pw and not isinstance(pw[0], (tuple, list)):
+        pw = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(a, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(a, pw, mode=jmode)
+
+
+@register("concat", aliases=("Concat",))
+def _concat(*args, dim=1, **_):
+    return jnp.concatenate([jnp.asarray(a) for a in args], axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, **_):
+    return jnp.stack([jnp.asarray(a) for a in args], axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=-1)
+def _split(a, num_outputs=1, axis=1, squeeze_axis=False, **_):
+    parts = jnp.split(a, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("slice_axis")
+def _slice_axis(a, axis=0, begin=0, end=None, **_):
+    n = a.shape[axis]
+    if end is None:
+        end = n
+    if begin < 0:
+        begin += n
+    if end < 0:
+        end += n
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(begin, end)
+    return a[tuple(idx)]
+
+
+@register("slice", aliases=("crop",))
+def _slice(a, begin=(), end=(), step=None, **_):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return a[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(a, b, axes=(), **_):
+    idx = [slice(None)] * a.ndim
+    axes = axes or range(a.ndim)
+    for ax in axes:
+        idx[ax] = slice(0, b.shape[ax])
+    return a[tuple(idx)]
+
+
+@register("_slice_index")
+def _slice_index(a, key=None, **_):
+    return a[key]
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(a, axis=0, **_):
+    return jnp.flip(a, axis)
+
+
+@register("where")
+def _where(cond, x, y, **_):
+    return jnp.where(jnp.asarray(cond).astype(bool), x, y)
+
+
+@register("diag")
+def _diag(a, k=0, **_):
+    return jnp.diag(a, k) if a.ndim <= 2 else jnp.diagonal(a, k, -2, -1)
+
+
+@register("zeros_like")
+def _zeros_like(a, **_):
+    return jnp.zeros_like(a)
+
+
+@register("ones_like")
+def _ones_like(a, **_):
+    return jnp.ones_like(a)
+
+
+@register("full_like")
+def _full_like(a, fill_value=0.0, **_):
+    return jnp.full_like(a, fill_value)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(a, **_):
+    return jnp.asarray(a.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def _size_array(a, **_):
+    return jnp.asarray([a.size], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------- indexing
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip", **_):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("Embedding", aliases=("embedding",))
+def _embedding(data, weight, input_dim=None, output_dim=None, **_):
+    idx = jnp.asarray(data).astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(a, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **_):
+    from ..base import dtype_np
+    oh = jax.nn.one_hot(jnp.asarray(a).astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(dtype_np(dtype))
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
+    idx = jnp.clip(jnp.asarray(index).astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(data, indices, **_):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    # indices shape (M, ...) indexes the first M dims of data
+    return data[tuple(idx[i] for i in range(idx.shape[0]))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None, **_):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(data)
+
+
+@register("take_along_axis")
+def _take_along_axis(a, indices, axis=0, **_):
+    return jnp.take_along_axis(a, jnp.asarray(indices).astype(jnp.int32), axis)
+
+
+@register("boolean_mask", differentiable=False)
+def _boolean_mask(data, index, axis=0, **_):
+    # dynamic-shape op: eager-only (reference src/operator/contrib/boolean_mask.cc)
+    import numpy as onp
+    mask = onp.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+# ---------------------------------------------------------------- ordering
+
+@register("sort")
+def _sort(a, axis=-1, is_ascend=True, **_):
+    out = jnp.sort(a, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def _argsort(a, axis=-1, is_ascend=True, dtype="float32", **_):
+    out = jnp.argsort(a, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+@register("topk", differentiable=False)
+def _topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **_):
+    neg = not is_ascend
+    mv = jnp.moveaxis(a, axis, -1)
+    vals, idxs = lax.top_k(mv if neg else -mv, k)
+    if not neg:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    return idxs
+
+
+@register("shuffle", differentiable=False)
+def _shuffle(a, **_):
+    from ..random import next_key
+    return jax.random.permutation(next_key(), a, axis=0)
+
+
+# ---------------------------------------------------------------- linalg
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False, **_):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.dot(a, b)
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False, **_):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("batch_dot_auto")
+def _batch_dot_auto(a, b, **_):
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, **_):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def _linalg_potrf(a, **_):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_syrk")
+def _linalg_syrk(a, transpose=False, alpha=1.0, **_):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_trsm")
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        lower = not lower
+    if rightside:
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2), lower=not lower)
+        return alpha * jnp.swapaxes(xt, -1, -2)
+    return alpha * jax.scipy.linalg.solve_triangular(a, b, lower=lower)
+
+
+@register("L2Normalization")
+def _l2norm(a, eps=1e-10, mode="instance", **_):
+    if mode == "instance":
+        axes = tuple(range(1, a.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:
+        axes = tuple(range(1, a.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(a), axis=axes, keepdims=True) + eps)
+    return a / denom
+
+
+# ---------------------------------------------------------------- sequences
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.asarray(data)
+    length = data.shape[axis]
+    steps = jnp.arange(length)
+    shape = [1] * data.ndim
+    shape[axis] = length
+    steps = steps.reshape(shape)
+    seq = jnp.asarray(sequence_length)
+    bshape = [1] * data.ndim
+    bshape[1 - axis] = seq.shape[0]
+    mask = steps < seq.reshape(bshape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return jnp.asarray(data)[tuple(idx)]
+    seq = jnp.asarray(sequence_length).astype(jnp.int32) - 1
+    mv = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        mv, seq.reshape((1, -1) + (1,) * (mv.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis)
+    T = data.shape[axis]
+    mv = jnp.moveaxis(data, axis, 0)
+    seq = jnp.asarray(sequence_length).astype(jnp.int32)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < seq[None, :], seq[None, :] - 1 - t, t)  # (T,B)
+    out = jnp.take_along_axis(
+        mv, src.reshape(src.shape + (1,) * (mv.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)

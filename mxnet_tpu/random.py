@@ -1,0 +1,150 @@
+"""Random number management.
+
+Reference: per-device stateful generators (include/mxnet/random_generator.h:84
+CPU mt19937 array, :159 curandStatePhilox4_32_10_t) seeded by
+``mx.random.seed``.  TPU-native: jax's counter-based Philox keys.  A process
+-global key is split per draw for eager ops (preserving the stateful UX);
+inside a traced/hybridized function a *traced* key is pushed on a stack so the
+compiled program stays pure and reproducible — the CachedOp feeds a fresh fold
+of the global seed each call, mirroring how the reference hands kParallelRandom
+resources to kernels (include/mxnet/resource.h:42-46).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint", "randn",
+           "multinomial", "exponential", "gamma", "poisson",
+           "negative_binomial", "generalized_negative_binomial"]
+
+
+class _KeyState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.counter = 0
+        self.trace_stack = []
+
+
+_STATE = _KeyState()
+
+
+def seed(seed_state, ctx="all"):
+    """Set the global seed (reference: MXRandomSeed / mx.random.seed)."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.counter = 0
+
+
+def next_key():
+    """A fresh PRNG key: split of the traced key inside trace scope, split of
+    the global stateful key otherwise."""
+    if _STATE.trace_stack:
+        key, sub = jax.random.split(_STATE.trace_stack[-1])
+        _STATE.trace_stack[-1] = key
+        return sub
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+class trace_key_scope:
+    """Push a (possibly traced) key for the duration of a traced call."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        _STATE.trace_stack.append(self.key)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_stack.pop()
+
+
+def new_eager_seed_key():
+    """A concrete key derived from global state, for feeding a traced call."""
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+# ----------------------------------------------------------------- samplers
+
+def _mk(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _wrap_out(val, ctx=None):
+    from .ndarray.ndarray import _wrap
+    import jax as _jax
+    if ctx is not None:
+        val = _jax.device_put(val, ctx.jax_device)
+    return _wrap(val)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **_):
+    from .base import dtype_np
+    val = jax.random.uniform(next_key(), _mk(shape), dtype_np(dtype), low, high)
+    return _wrap_out(val, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **_):
+    from .base import dtype_np
+    val = loc + scale * jax.random.normal(next_key(), _mk(shape), dtype_np(dtype))
+    return _wrap_out(val, ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None, **_):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, **_):
+    if high is None:
+        low, high = 0, low
+    val = jax.random.randint(next_key(), _mk(shape), low, high)
+    return _wrap_out(val, ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **_):
+    import jax.numpy as jnp
+    probs = data._data if hasattr(data, "_data") else jax.numpy.asarray(data)
+    n = 1 if shape is None else shape
+    logits = jnp.log(jnp.maximum(probs, 1e-38))
+    out = jax.random.categorical(next_key(), logits, axis=-1,
+                                 shape=(_mk(n) + logits.shape[:-1]) if shape else logits.shape[:-1])
+    if shape:
+        out = jnp.moveaxis(out, 0, -1) if out.ndim > len(logits.shape[:-1]) else out
+    return _wrap_out(out.astype(jax.numpy.int32))
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **_):
+    from .base import dtype_np
+    val = scale * jax.random.exponential(next_key(), _mk(shape), dtype_np(dtype))
+    return _wrap_out(val, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **_):
+    from .base import dtype_np
+    val = beta * jax.random.gamma(next_key(), alpha, _mk(shape), dtype_np(dtype))
+    return _wrap_out(val, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **_):
+    val = jax.random.poisson(next_key(), lam, _mk(shape)).astype("float32")
+    return _wrap_out(val, ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **_):
+    g = jax.random.gamma(next_key(), k, _mk(shape)) * (1.0 - p) / p
+    val = jax.random.poisson(next_key(), g, _mk(shape)).astype("float32")
+    return _wrap_out(val, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32",
+                                  ctx=None, **_):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return negative_binomial(k, p, shape, dtype, ctx)

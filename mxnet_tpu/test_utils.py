@@ -1,0 +1,113 @@
+"""Test utilities — the de-facto op test harness.
+
+Reference: python/mxnet/test_utils.py — assert_almost_equal,
+check_numeric_gradient (finite differences), check_consistency (cross-device),
+default_context.  TPU-native: the numeric-gradient check validates the *taped*
+autograd against central finite differences, and check_symbolic_backward-style
+checks compare against jax.grad of the pure op — two independent gradient
+paths, same contract as the reference's.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "default_context",
+           "check_numeric_gradient", "check_consistency", "rand_ndarray",
+           "rand_shape_nd"]
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return _np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+    a, b = _as_np(a), _as_np(b)
+    if not _np.allclose(a, b, rtol=rtol, atol=atol):
+        idx = _np.unravel_index(
+            _np.argmax(_np.abs(a.astype("float64") - b.astype("float64"))), a.shape) if a.shape else ()
+        raise AssertionError(
+            "arrays not almost equal (rtol=%g atol=%g); max err at %s: %s=%r %s=%r"
+            % (rtol, atol, idx, names[0], a[idx] if a.shape else a,
+               names[1], b[idx] if b.shape else b))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None):
+    data = _np.random.uniform(-1, 1, size=shape).astype(dtype)
+    arr = array(data, ctx=ctx)
+    if stype != "default":
+        return arr.tostype(stype)
+    return arr
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-2, rtol=2e-2, atol=2e-3):
+    """Validate taped autograd of ``fn(*NDArrays)->NDArray scalar-or-any`` vs
+    central finite differences (reference test_utils.check_numeric_gradient).
+    """
+    from . import autograd
+
+    nds = [array(_np.asarray(x, dtype="float64").astype("float32"))
+           for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        loss = out.sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in nds]
+
+    for i, x in enumerate(inputs):
+        x = _np.asarray(x, dtype="float64")
+        num = _np.zeros_like(x)
+        flat = x.reshape(-1)
+        nflat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(fn(*[array(v.astype("float32")) for v in
+                            [x if k == i else _np.asarray(inputs[k], dtype="float64")
+                             for k in range(len(inputs))]]).sum().asscalar())
+            flat[j] = orig - eps
+            fm = float(fn(*[array(v.astype("float32")) for v in
+                            [x if k == i else _np.asarray(inputs[k], dtype="float64")
+                             for k in range(len(inputs))]]).sum().asscalar())
+            flat[j] = orig
+            nflat[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
+                            names=("autograd", "numeric"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-5, atol=1e-6):
+    """Run the same computation on each context and compare (reference
+    check_consistency cpu-vs-gpu; here host cpu vs accelerator)."""
+    if ctx_list is None:
+        ctx_list = [cpu(), current_context()]
+    outs = []
+    for ctx in ctx_list:
+        nds = [array(x, ctx=ctx) for x in inputs]
+        outs.append(_as_np(fn(*nds)))
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol,
+                            names=("ctx0", "ctxN"))

@@ -1,0 +1,164 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2, 4, 6])
+
+
+def test_grad_accumulate_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with ag.record():
+            y = (x * 3).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6, 6])
+
+
+def test_multi_use():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x
+    y.backward()
+    assert_almost_equal(x.grad, [5.0])
+
+
+def test_chain_rule_through_ops():
+    x = mx.nd.array([0.5, 1.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(mx.nd.sin(x)).sum()
+    y.backward()
+    expected = np.cos([0.5, 1.0]) * np.exp(np.sin([0.5, 1.0]))
+    assert_almost_equal(x.grad, expected, rtol=1e-5)
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+    y.backward(mx.nd.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, [2, 20])
+
+
+def test_detach_blocks():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [2.0])  # only via second factor
+
+
+def test_stop_gradient_op():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.BlockGrad(x * 2) + x
+    y.backward()
+    assert_almost_equal(x.grad, [1.0])
+
+
+def test_is_recording_training():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+    assert not ag.is_recording()
+    with ag.train_mode():
+        assert ag.is_training()
+    with ag.predict_mode():
+        assert not ag.is_training()
+
+
+def test_grad_function():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.relu(x * -1 + 1.5)
+    y.backward()
+    assert_almost_equal(x.grad, [-1.0, 0.0])
+
+
+def test_retain_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asscalar()
+    y.backward()
+    assert g1 == 4.0
+    with ag.record():
+        z = x * x
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    (g,) = ag.grad(y, [x])
+    assert_almost_equal(g, [6.0])
+    # .grad untouched
+    assert x.grad.asscalar() == 0.0
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            import mxnet_tpu as mx
+            with ag.pause():
+                y = mx.nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward(mx.nd.ones((2,)))
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_numeric_gradient_harness():
+    check_numeric_gradient(lambda x: mx.nd.tanh(x), [np.random.rand(3, 2)])
+    check_numeric_gradient(lambda a, b: a * b + mx.nd.exp(a),
+                           [np.random.rand(2, 2), np.random.rand(2, 2)])
+
+
+def test_grad_through_softmax_fc():
+    x = mx.nd.array(np.random.rand(4, 8).astype("float32"))
+    w = mx.nd.array(np.random.rand(3, 8).astype("float32") * 0.1)
+    w.attach_grad()
+    with ag.record():
+        out = mx.nd.softmax(mx.nd.FullyConnected(x, w, None, no_bias=True, num_hidden=3))
+        loss = -mx.nd.log(out + 1e-8).sum()
+    loss.backward()
+    assert w.grad.asnumpy().shape == (3, 8)
+    assert np.abs(w.grad.asnumpy()).sum() > 0

@@ -1,0 +1,175 @@
+"""NDArray semantics tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    b = mx.nd.array(np.arange(6, dtype="int32").reshape(2, 3))
+    assert b.dtype == np.int32
+    assert mx.nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert mx.nd.ones((2, 3)).asnumpy().sum() == 6
+    assert mx.nd.full((2,), 7).asnumpy().tolist() == [7, 7]
+    assert mx.nd.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+
+
+def test_arithmetic():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, [5, 7, 9])
+    assert_almost_equal(a - b, [-3, -3, -3])
+    assert_almost_equal(a * b, [4, 10, 18])
+    assert_almost_equal(b / a, [4, 2.5, 2])
+    assert_almost_equal(2 + a, [3, 4, 5])
+    assert_almost_equal(2 - a, [1, 0, -1])
+    assert_almost_equal(a ** 2, [1, 4, 9])
+    assert_almost_equal(-a, [-1, -2, -3])
+    assert_almost_equal(abs(mx.nd.array([-1.0, 2.0])), [1, 2])
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert (a == b).asnumpy().tolist() == [0, 1, 0]
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+    assert (a <= b).asnumpy().tolist() == [1, 1, 0]
+
+
+def test_inplace():
+    a = mx.nd.ones((3,))
+    a += 1
+    assert a.asnumpy().tolist() == [2, 2, 2]
+    a *= 3
+    assert a.asnumpy().tolist() == [6, 6, 6]
+    a[:] = 0
+    assert a.asnumpy().tolist() == [0, 0, 0]
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    assert a[1].asnumpy().tolist() == [4, 5, 6, 7]
+    assert a[1, 2].asscalar() == 6
+    assert a[0:2, 1].asnumpy().tolist() == [1, 5]
+    a[0, 0] = 99
+    assert a[0, 0].asscalar() == 99
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert a[idx].shape == (2, 4)
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(6).astype("float32"))
+    assert a.reshape(2, 3).shape == (2, 3)
+    assert a.reshape((3, -1)).shape == (3, 2)
+    assert a.reshape(2, 3).T.shape == (3, 2)
+    b = mx.nd.ones((2, 3, 4))
+    assert b.transpose().shape == (4, 3, 2)
+    assert b.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+    assert b.reshape(0, -1).shape == (2, 12)  # MXNet 0/-1 magic
+    assert b.expand_dims(1).shape == (2, 1, 3, 4)
+    assert b.flatten().shape == (2, 12)
+
+
+def test_reductions():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    assert a.sum(axis=0).asnumpy().tolist() == [4, 6]
+    assert a.mean(axis=1, keepdims=True).shape == (2, 1)
+    assert a.max().asscalar() == 4
+    assert a.argmax(axis=1).asnumpy().tolist() == [1, 1]
+    assert_almost_equal(a.norm(), np.sqrt(30), rtol=1e-5)
+
+
+def test_dot():
+    a = mx.nd.array(np.random.rand(3, 4).astype("float32"))
+    b = mx.nd.array(np.random.rand(4, 5).astype("float32"))
+    assert_almost_equal(mx.nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(mx.nd.dot(a, b.T.copy(), transpose_b=True),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = mx.nd.split(c, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == (2, 3)
+    st = mx.nd.stack(a, b, axis=0)
+    assert st.shape == (2, 2, 3)
+
+
+def test_astype_copy():
+    a = mx.nd.array([1.5, 2.5])
+    assert a.astype("int32").dtype == np.int32
+    b = a.copy()
+    b += 1
+    assert a.asnumpy().tolist() == [1.5, 2.5]
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.bin")
+    d = {"w": mx.nd.ones((2, 2)), "b": mx.nd.zeros((3,))}
+    mx.nd.save(f, d)
+    loaded = mx.nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert loaded["w"].asnumpy().sum() == 4
+    mx.nd.save(f, [mx.nd.ones((2,))])
+    ld = mx.nd.load(f)
+    assert isinstance(ld, list) and ld[0].shape == (2,)
+
+
+def test_context():
+    a = mx.nd.ones((2,), ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a or b.shape == a.shape
+
+
+def test_waitall_and_wait_to_read():
+    a = mx.nd.ones((8, 8))
+    b = mx.nd.dot(a, a)
+    b.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_broadcast_ops():
+    a = mx.nd.ones((2, 1, 3))
+    b = mx.nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    assert a.broadcast_to((2, 5, 3)).shape == (2, 5, 3)
+    c = mx.nd.ones((2, 3))
+    assert mx.nd.broadcast_axis(c.expand_dims(0), axis=0, size=4).shape == (4, 2, 3)
+
+
+def test_take_pick_onehot():
+    a = mx.nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    t = mx.nd.take(a, mx.nd.array([0, 2], dtype="int32"))
+    assert t.shape == (2, 4)
+    p = mx.nd.pick(a, mx.nd.array([0, 1, 2]), axis=1)
+    assert p.asnumpy().tolist() == [0, 5, 10]
+    oh = mx.nd.one_hot(mx.nd.array([0, 2], dtype="int32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_topk_sort():
+    a = mx.nd.array([[3.0, 1.0, 2.0]])
+    assert mx.nd.topk(a, k=2, ret_typ="value").asnumpy().tolist() == [[3, 2]]
+    assert mx.nd.sort(a).asnumpy().tolist() == [[1, 2, 3]]
+    assert mx.nd.argsort(a).asnumpy().tolist() == [[1, 2, 0]]
+
+
+def test_mutation_guard_under_record():
+    a = mx.nd.ones((2,))
+    a.attach_grad()
+    with mx.autograd.record():
+        b = a * 2
+        with pytest.raises(RuntimeError):
+            a += 1
+        with pytest.raises(RuntimeError):
+            b[:] = 0
