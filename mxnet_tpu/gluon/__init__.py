@@ -1,0 +1,22 @@
+"""Gluon: imperative/hybrid neural-network API.
+
+Reference: ``python/mxnet/gluon/`` — Parameter/ParameterDict, Block/
+HybridBlock/SymbolBlock, Trainer, losses, nn/rnn layers, data, model_zoo.
+"""
+from . import parameter
+from .parameter import Parameter, ParameterDict, Constant
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import trainer
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "utils", "data", "rnn",
+           "model_zoo", "contrib", "parameter", "block", "trainer"]

@@ -1,0 +1,3 @@
+"""Contrib neural network blocks (reference: python/mxnet/gluon/contrib/)."""
+from . import nn
+from . import rnn

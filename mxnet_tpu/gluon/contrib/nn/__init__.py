@@ -1,0 +1,2 @@
+"""Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from .basic_layers import *

@@ -1,0 +1,159 @@
+"""Contrib layers.
+
+Reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py`` — Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm, PixelShuffle.
+"""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential, BatchNorm, Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Lays Blocks concurrently, concatenating outputs
+    (reference: contrib/nn/basic_layers.py:34)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = []
+        for block in self._children.values():
+            out.append(block(x))
+        from .... import ndarray as F
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Lays HybridBlocks concurrently, concatenating outputs
+    (reference: contrib/nn/basic_layers.py:70)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = []
+        for block in self._children.values():
+            out.append(block(x))
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity block (reference: contrib/nn/basic_layers.py:106)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row_sparse gradients
+    (reference: contrib/nn/basic_layers.py:130).  On TPU dense scatter-add
+    gradients are the efficient form; sparse_grad is recorded for parity."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm
+    (reference: contrib/nn/basic_layers.py:184).
+
+    Under SPMD (pjit over a Mesh) batch statistics are computed over the
+    *global* batch automatically when the reduction spans the batch-sharded
+    axis — XLA inserts the cross-replica psum.  This subclass exists for API
+    parity; num_devices is accepted and unused.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Pixel-shuffle upsampling 1D (reference: contrib/nn/basic_layers.py:263)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        n, c, w = x.shape
+        x = F.reshape(x, shape=(n, c // f, f, w))
+        x = F.transpose(x, axes=(0, 1, 3, 2))
+        x = F.reshape(x, shape=(n, c // f, w * f))
+        return x
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factor)
+
+
+class PixelShuffle2D(HybridBlock):
+    """Pixel-shuffle upsampling 2D (reference: contrib/nn/basic_layers.py:305)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 2, "wrong length {}".format(
+                len(self._factors))
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        x = F.reshape(x, shape=(n, c // (f1 * f2), f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        x = F.reshape(x, shape=(n, c // (f1 * f2), h * f1, w * f2))
+        return x
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factors)
+
+
+class PixelShuffle3D(HybridBlock):
+    """Pixel-shuffle upsampling 3D (reference: contrib/nn/basic_layers.py:357)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 3
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 3, "wrong length {}".format(
+                len(self._factors))
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        n, c, d, h, w = x.shape
+        x = F.reshape(x, shape=(n, c // (f1 * f2 * f3), f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        x = F.reshape(x, shape=(n, c // (f1 * f2 * f3), d * f1, h * f2,
+                                w * f3))
+        return x
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factors)

@@ -1,0 +1,6 @@
+"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/).
+
+Conv RNN cells and VariationalDropoutCell are tracked as future parity work;
+the core cells live in mxnet_tpu.gluon.rnn.
+"""
+from ...rnn import (RecurrentCell, HybridRecurrentCell)  # noqa: F401

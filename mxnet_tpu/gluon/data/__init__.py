@@ -1,0 +1,6 @@
+"""Dataset utilities (reference: python/mxnet/gluon/data/)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision
+from .vision import transforms

@@ -1,0 +1,376 @@
+"""Image transforms.
+
+Reference: ``python/mxnet/gluon/data/vision/transforms.py`` — Compose, Cast,
+ToTensor, Normalize, Resize, crops, flips, color jitter.  Transforms operate
+per-sample on host (HWC uint8/float NDArrays); batched device math happens
+after collation.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from ....ndarray.ndarray import NDArray, array as nd_array, _wrap
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "CropResize", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom", "RandomBrightness",
+           "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomColorJitter", "RandomLighting"]
+
+
+def _as_np_img(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially composes multiple transforms
+    (reference: transforms.py:37)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            elif len(hybrid) == 1:
+                self.add(hybrid[0])
+                hybrid = []
+            elif len(hybrid) > 1:
+                hblock = HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                self.add(hblock)
+                hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    """Cast input to a specific data type (reference: transforms.py:81)."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """Converts HWC uint8 [0,255] to CHW float32 [0,1)
+    (reference: transforms.py:102; op src/operator/image/image_random.cc)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def hybrid_forward(self, F, x):
+        if isinstance(x, NDArray):
+            arr = x.astype("float32") / 255.0
+            if arr.ndim == 3:
+                return arr.transpose((2, 0, 1))
+            return arr.transpose((0, 3, 1, 2))
+        raise TypeError("ToTensor expects NDArray input")
+
+
+class Normalize(HybridBlock):
+    """Normalize a CHW float tensor with mean and std
+    (reference: transforms.py:139)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = _np.asarray(self._mean, dtype="float32").reshape((-1, 1, 1))
+        std = _np.asarray(self._std, dtype="float32").reshape((-1, 1, 1))
+        return (x - nd_array(mean)) / nd_array(std)
+
+
+def _resize_np(img, size, interp=1):
+    """Bilinear (interp=1) or nearest (interp=0) resize for HWC numpy."""
+    h, w = img.shape[:2]
+    ow, oh = size if isinstance(size, (tuple, list)) else (size, size)
+    if (oh, ow) == (h, w):
+        return img
+    ys = _np.linspace(0, h - 1, oh)
+    xs = _np.linspace(0, w - 1, ow)
+    if interp == 0:
+        out = img[_np.round(ys).astype(int)][:, _np.round(xs).astype(int)]
+        return out
+    y0 = _np.floor(ys).astype(int)
+    x0 = _np.floor(xs).astype(int)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img.astype(_np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class Resize(Block):
+    """Resize image to the given size (reference: transforms.py:183)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._keep = keep_ratio
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _as_np_img(x)
+        if isinstance(self._size, int):
+            if self._keep:
+                h, w = img.shape[:2]
+                if h > w:
+                    size = (self._size, int(h * self._size / w))
+                else:
+                    size = (int(w * self._size / h), self._size)
+            else:
+                size = (self._size, self._size)
+        else:
+            size = self._size
+        out = _resize_np(img, size, self._interpolation)
+        return nd_array(out.astype(img.dtype if img.dtype == _np.uint8
+                                   else _np.float32))
+
+
+class CropResize(Block):
+    """Crop then optionally resize (reference: transforms.py:142 image.py)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x = x
+        self._y = y
+        self._width = width
+        self._height = height
+        self._size = size
+        self._interpolation = interpolation or 1
+
+    def forward(self, data):
+        img = _as_np_img(data)
+        out = img[self._y:self._y + self._height,
+                  self._x:self._x + self._width]
+        if self._size:
+            out = _resize_np(out, self._size, self._interpolation)
+        return nd_array(out)
+
+
+class CenterCrop(Block):
+    """Crop the center of the image (reference: transforms.py:225)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _as_np_img(x)
+        h, w = img.shape[:2]
+        ow, oh = self._size
+        if h < oh or w < ow:
+            img = _resize_np(img, (max(ow, w), max(oh, h)), self._interpolation)
+            h, w = img.shape[:2]
+        y0 = (h - oh) // 2
+        x0 = (w - ow) // 2
+        return nd_array(img[y0:y0 + oh, x0:x0 + ow])
+
+
+class RandomCrop(Block):
+    """Randomly crop to size, padding if needed."""
+
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _as_np_img(x)
+        if self._pad:
+            p = self._pad
+            img = _np.pad(img, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = img.shape[:2]
+        ow, oh = self._size
+        if h < oh or w < ow:
+            img = _resize_np(img, (max(ow, w), max(oh, h)), self._interpolation)
+            h, w = img.shape[:2]
+        y0 = _pyrandom.randint(0, h - oh)
+        x0 = _pyrandom.randint(0, w - ow)
+        return nd_array(img[y0:y0 + oh, x0:x0 + ow])
+
+
+class RandomResizedCrop(Block):
+    """Random crop with area/ratio jitter, resized to size
+    (reference: transforms.py:257)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _as_np_img(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _pyrandom.uniform(*self._scale) * area
+            log_ratio = (_np.log(self._ratio[0]), _np.log(self._ratio[1]))
+            aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+            cw = int(round((target_area * aspect) ** 0.5))
+            ch = int(round((target_area / aspect) ** 0.5))
+            if cw <= w and ch <= h:
+                x0 = _pyrandom.randint(0, w - cw)
+                y0 = _pyrandom.randint(0, h - ch)
+                crop = img[y0:y0 + ch, x0:x0 + cw]
+                return nd_array(_resize_np(crop, self._size,
+                                           self._interpolation).astype(img.dtype))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation).forward(nd_array(img))
+
+
+class RandomFlipLeftRight(Block):
+    """Random horizontal flip (reference: transforms.py:301)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() < self._p:
+            return nd_array(_as_np_img(x)[:, ::-1])
+        return x if isinstance(x, NDArray) else nd_array(x)
+
+
+class RandomFlipTopBottom(Block):
+    """Random vertical flip (reference: transforms.py:318)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() < self._p:
+            return nd_array(_as_np_img(x)[::-1])
+        return x if isinstance(x, NDArray) else nd_array(x)
+
+
+class _RandomColorBase(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _alpha(self):
+        return 1.0 + _pyrandom.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomColorBase):
+    """Random brightness jitter (reference: transforms.py:335)."""
+
+    def forward(self, x):
+        img = _as_np_img(x).astype(_np.float32)
+        return nd_array(img * self._alpha())
+
+
+class RandomContrast(_RandomColorBase):
+    """Random contrast jitter (reference: transforms.py:352)."""
+
+    def forward(self, x):
+        img = _as_np_img(x).astype(_np.float32)
+        alpha = self._alpha()
+        gray = img.mean()
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class RandomSaturation(_RandomColorBase):
+    """Random saturation jitter (reference: transforms.py:369)."""
+
+    def forward(self, x):
+        img = _as_np_img(x).astype(_np.float32)
+        alpha = self._alpha()
+        coef = _np.array([0.299, 0.587, 0.114], dtype=_np.float32)
+        gray = (img * coef).sum(axis=2, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class RandomHue(_RandomColorBase):
+    """Random hue jitter (reference: transforms.py:386)."""
+
+    def forward(self, x):
+        img = _as_np_img(x).astype(_np.float32)
+        alpha = _pyrandom.uniform(-self._amount, self._amount)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], dtype=_np.float32)
+        tyiq = _np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], dtype=_np.float32)
+        ityiq = _np.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], dtype=_np.float32)
+        t = ityiq @ bt @ tyiq
+        return nd_array(img @ t.T)
+
+
+class RandomColorJitter(Block):
+    """Random brightness/contrast/saturation/hue jitter
+    (reference: transforms.py:403)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = list(range(len(self._ts)))
+        _pyrandom.shuffle(order)
+        for i in order:
+            x = self._ts[i].forward(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference: transforms.py:428)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = _as_np_img(x).astype(_np.float32)
+        alpha = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_array(img + rgb)

@@ -1,0 +1,4 @@
+"""Neural network layers (reference: python/mxnet/gluon/nn/)."""
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *

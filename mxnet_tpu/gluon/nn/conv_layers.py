@@ -1,0 +1,425 @@
+"""Convolutional and pooling layers.
+
+Reference: ``python/mxnet/gluon/nn/conv_layers.py`` — _Conv base, Conv1D/2D/3D,
+Conv1-3DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling, ReflectionPad2D.
+Convs lower onto the MXU via lax.conv_general_dilated (see ops/nn.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _to_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Base conv layer (reference: conv_layers.py:36)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._channels = channels
+            self._in_channels = in_channels
+            if isinstance(strides, int):
+                strides = (strides,) * len(kernel_size)
+            if isinstance(padding, int):
+                padding = (padding,) * len(kernel_size)
+            if isinstance(dilation, int):
+                dilation = (dilation,) * len(kernel_size)
+            self._op_name = op_name
+            self._kwargs = {
+                "kernel": kernel_size, "stride": strides, "dilate": dilation,
+                "pad": padding, "num_filter": channels, "num_group": groups,
+                "no_bias": not use_bias, "layout": layout}
+            if adj is not None:
+                self._kwargs["adj"] = adj
+            self._groups = groups
+
+            if op_name == "Convolution":
+                wshapes_0 = channels
+                wshapes_1 = in_channels // groups if in_channels else 0
+            else:  # Deconvolution: (in_channels, channels//groups, *k)
+                wshapes_0 = in_channels
+                wshapes_1 = channels // groups
+            if op_name == "Convolution":
+                wshape = (wshapes_0, wshapes_1) + tuple(kernel_size)
+            else:
+                wshape = (wshapes_0, wshapes_1) + tuple(kernel_size)
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+
+            if activation is not None:
+                from .activations import Activation
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x, *args):
+        in_channels = x.shape[1]
+        ws = list(self.weight.shape)
+        if self._op_name == "Convolution":
+            ws[1] = in_channels // self._groups
+            ws[0] = self._channels
+        else:
+            ws[0] = in_channels
+            ws[1] = self._channels // self._groups
+        self.weight.shape = tuple(ws)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            act = op(x, weight, name="fwd", **self._kwargs)
+        else:
+            act = op(x, weight, bias, name="fwd", **self._kwargs)
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def _alias(self):
+        return "conv"
+
+    def __repr__(self):
+        s = "{name}({mapping}, kernel_size={kernel}, stride={stride}"
+        len_kernel_size = len(self._kwargs["kernel"])
+        if self._kwargs["pad"] != (0,) * len_kernel_size:
+            s += ", padding={pad}"
+        if self._kwargs["dilate"] != (1,) * len_kernel_size:
+            s += ", dilation={dilate}"
+        if hasattr(self, "out_pad") and self.out_pad != (0,) * len_kernel_size:
+            s += ", output_padding={out_pad}".format(out_pad=self.out_pad)
+        if self._kwargs["num_group"] != 1:
+            s += ", groups={num_group}"
+        if self.bias is None:
+            s += ", bias=False"
+        if self.act:
+            s += ", {}".format(self.act)
+        s += ")"
+        shape = self.weight.shape
+        return s.format(name=self.__class__.__name__,
+                        mapping="{0} -> {1}".format(
+                            shape[1] if shape[1] else None, shape[0]),
+                        **self._kwargs)
+
+
+class Conv1D(_Conv):
+    """1D convolution (reference: conv_layers.py:180)."""
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        assert len(kernel_size) == 1, "kernel_size must be a number or a list of 1 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    """2D convolution (reference: conv_layers.py:259)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
+        assert len(kernel_size) == 2, "kernel_size must be a number or a list of 2 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    """3D convolution (reference: conv_layers.py:341)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        assert len(kernel_size) == 3, "kernel_size must be a number or a list of 3 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    """1D transposed convolution (reference: conv_layers.py:425)."""
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        if isinstance(output_padding, int):
+            output_padding = (output_padding,)
+        assert len(kernel_size) == 1, "kernel_size must be a number or a list of 1 ints"
+        assert len(output_padding) == 1, "output_padding must be a number or a list of 1 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, op_name="Deconvolution", adj=output_padding,
+            **kwargs)
+        self.outpad = output_padding
+
+
+class Conv2DTranspose(_Conv):
+    """2D transposed convolution (reference: conv_layers.py:511)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
+        if isinstance(output_padding, int):
+            output_padding = (output_padding,) * 2
+        assert len(kernel_size) == 2, "kernel_size must be a number or a list of 2 ints"
+        assert len(output_padding) == 2, "output_padding must be a number or a list of 2 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, op_name="Deconvolution", adj=output_padding,
+            **kwargs)
+        self.outpad = output_padding
+
+
+class Conv3DTranspose(_Conv):
+    """3D transposed convolution (reference: conv_layers.py:601)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        if isinstance(output_padding, int):
+            output_padding = (output_padding,) * 3
+        assert len(kernel_size) == 3, "kernel_size must be a number or a list of 3 ints"
+        assert len(output_padding) == 3, "output_padding must be a number or a list of 3 ints"
+        super().__init__(
+            channels, kernel_size, strides, padding, dilation, groups, layout,
+            in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, op_name="Deconvolution", adj=output_padding,
+            **kwargs)
+        self.outpad = output_padding
+
+
+class _Pooling(HybridBlock):
+    """Abstract pooling layer (reference: conv_layers.py:693)."""
+
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 global_pool=False, pool_type="max", layout=None,
+                 count_include_pad=None, **kwargs):
+        super().__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        if isinstance(strides, int):
+            strides = (strides,) * len(pool_size)
+        if isinstance(padding, int):
+            padding = (padding,) * len(pool_size)
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, name="fwd", **self._kwargs)
+
+    def __repr__(self):
+        s = "{name}(size={kernel}, stride={stride}, padding={pad}, ceil_mode={ceil_mode}"
+        s += ", global_pool={global_pool}, pool_type={pool_type}, layout=NCHW)"
+        return s.format(name=self.__class__.__name__,
+                        ceil_mode=self._kwargs["pooling_convention"] == "full",
+                        **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    """Max pooling 1D (reference: conv_layers.py:746)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        assert layout in ("NCW", "NWC"), \
+            "Only NCW and NWC layouts are valid for 1D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,)
+        assert len(pool_size) == 1, "pool_size must be a number or a list of 1 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    """Max pooling 2D (reference: conv_layers.py:800)."""
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        assert layout in ("NCHW", "NHWC"), \
+            "Only NCHW and NHWC layouts are valid for 2D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,) * 2
+        assert len(pool_size) == 2, "pool_size must be a number or a list of 2 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    """Max pooling 3D (reference: conv_layers.py:857)."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, layout="NCDHW", **kwargs):
+        assert layout in ("NCDHW", "NDHWC"), \
+            "Only NCDHW and NDHWC layouts are valid for 3D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,) * 3
+        assert len(pool_size) == 3, "pool_size must be a number or a list of 3 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    """Average pooling 1D (reference: conv_layers.py:917)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        assert layout in ("NCW", "NWC"), \
+            "Only NCW and NWC layouts are valid for 1D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,)
+        assert len(pool_size) == 1, "pool_size must be a number or a list of 1 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    """Average pooling 2D (reference: conv_layers.py:975)."""
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 ceil_mode=False, layout="NCHW", count_include_pad=True,
+                 **kwargs):
+        assert layout in ("NCHW", "NHWC"), \
+            "Only NCHW and NHWC layouts are valid for 2D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,) * 2
+        assert len(pool_size) == 2, "pool_size must be a number or a list of 2 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    """Average pooling 3D (reference: conv_layers.py:1036)."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, layout="NCDHW", count_include_pad=True,
+                 **kwargs):
+        assert layout in ("NCDHW", "NDHWC"), \
+            "Only NCDHW and NDHWC layouts are valid for 3D Pooling"
+        if isinstance(pool_size, int):
+            pool_size = (pool_size,) * 3
+        assert len(pool_size) == 3, "pool_size must be a number or a list of 3 ints"
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    """Global max pooling 1D (reference: conv_layers.py:1097)."""
+
+    def __init__(self, layout="NCW", **kwargs):
+        assert layout in ("NCW", "NWC"), \
+            "Only NCW and NWC layouts are valid for 1D Pooling"
+        super().__init__((1,), None, 0, True, True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    """Global max pooling 2D (reference: conv_layers.py:1125)."""
+
+    def __init__(self, layout="NCHW", **kwargs):
+        assert layout in ("NCHW", "NHWC"), \
+            "Only NCHW and NHWC layouts are valid for 2D Pooling"
+        super().__init__((1, 1), None, 0, True, True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    """Global max pooling 3D (reference: conv_layers.py:1153)."""
+
+    def __init__(self, layout="NCDHW", **kwargs):
+        assert layout in ("NCDHW", "NDHWC"), \
+            "Only NCDHW and NDHWC layouts are valid for 3D Pooling"
+        super().__init__((1, 1, 1), None, 0, True, True, "max", layout, **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    """Global average pooling 1D (reference: conv_layers.py:1181)."""
+
+    def __init__(self, layout="NCW", **kwargs):
+        assert layout in ("NCW", "NWC"), \
+            "Only NCW and NWC layouts are valid for 1D Pooling"
+        super().__init__((1,), None, 0, True, True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    """Global average pooling 2D (reference: conv_layers.py:1204)."""
+
+    def __init__(self, layout="NCHW", **kwargs):
+        assert layout in ("NCHW", "NHWC"), \
+            "Only NCHW and NHWC layouts are valid for 2D Pooling"
+        super().__init__((1, 1), None, 0, True, True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    """Global average pooling 3D (reference: conv_layers.py:1227)."""
+
+    def __init__(self, layout="NCDHW", **kwargs):
+        assert layout in ("NCDHW", "NDHWC"), \
+            "Only NCDHW and NDHWC layouts are valid for 3D Pooling"
+        super().__init__((1, 1, 1), None, 0, True, True, "avg", layout, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H and W (reference: conv_layers.py:1250)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        assert len(padding) == 8
+        self._padding = tuple((padding[2 * i], padding[2 * i + 1])
+                              for i in range(4))
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

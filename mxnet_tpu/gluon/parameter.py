@@ -1,0 +1,518 @@
+"""Parameter and ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py`` (1,053 LoC) — a Parameter owns
+per-context data copies + gradient buffers with deferred shape inference; a
+ParameterDict is a prefix-scoped registry shared across blocks.
+
+TPU-native notes: a Parameter's value is one jax.Array handle (NDArray); for
+multi-device data parallelism the value is *sharded* over a Mesh by the
+parallel trainer (jax.sharding) instead of being replicated into per-context
+copies — ``list_data()`` returns the single logical value, matching how pjit
+subsumes the reference's per-GPU executor copies
+(python/mxnet/module/executor_group.py:144).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import dtype_np, MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, _wrap
+from ..ndarray import ndarray as ndarray_mod
+from .. import autograd
+from .. import initializer
+from .. import random as _random
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization (reference:
+    gluon/parameter.py:36)."""
+
+
+class Parameter:
+    """A Container holding parameters (weights) of Blocks
+    (reference: gluon/parameter.py:46).
+
+    Supports deferred initialization: shape may contain 0s (unknown dims)
+    resolved at first forward via the owning layer's shape inference.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        for st in (stype, grad_stype):
+            if st not in ("default", "row_sparse", "csr"):
+                raise ValueError("invalid stype '%s'" % st)
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    # ----------------------------------------------------------- properties
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), \
+            "grad_req must be one of 'write', 'add', or 'null', but got '%s'" % req
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._grad = None
+                self._data._is_leaf = False
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            "Expected shape %s is incompatible with given shape %s." % (
+                str(new_shape), str(self._shape))
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
+    # ------------------------------------------------------------- internal
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with Block.collect_params() "
+            "instead of Block.params because the later does not include "
+            "Parameters of nested child Blocks" % self.name)
+
+    def _load_init(self, data, ctx, cast_dtype=False, dtype_source="current"):
+        """Initialize from loaded data (reference: parameter.py:274)."""
+        if cast_dtype:
+            if dtype_source == "current":
+                data = data.astype(self.dtype)
+            elif dtype_source == "saved":
+                self._dtype = data.dtype
+        if self.shape:
+            unknown = any(s == 0 for s in self.shape)
+            if not unknown and tuple(self.shape) != tuple(data.shape):
+                raise AssertionError(
+                    "Failed loading Parameter '%s' from saved params: "
+                    "shape incompatible expected %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape)))
+            self._shape = tuple(data.shape)
+        if self.dtype is not None and not cast_dtype:
+            if _np.dtype(dtype_np(self.dtype)) != data.dtype:
+                raise AssertionError(
+                    "Failed loading Parameter '%s' from saved params: "
+                    "dtype incompatible expected %s vs saved %s. "
+                    "Set cast_dtype=True to cast the dtype of saved params." % (
+                        self.name, str(self.dtype), str(data.dtype)))
+        self._init_impl(data, ctx)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and _np.prod(self.shape) > 0, \
+            "Cannot initialize Parameter '%s' because it has invalid shape: %s. " \
+            "Please specify in_units, in_channels, etc for `Block`s." % (
+                self.name, str(self.shape))
+        with autograd.pause():
+            if data is None:
+                gen = init if init is not None else (
+                    self.init if self.init is not None else default_init)
+                gen = initializer.create(gen) if isinstance(gen, str) else gen
+                val = gen.generate(_random.new_eager_seed_key(), self.shape,
+                                   self.dtype, name=self.name)
+                data = _wrap(jnp.asarray(val, dtype_np(self.dtype)))
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        if isinstance(data, NDArray):
+            val = data._data
+        else:
+            val = jnp.asarray(data)
+        self._ctx_list = list(ctx_list) if ctx_list else [current_context()]
+        self._data = _wrap(jnp.asarray(val, dtype_np(self.dtype)))
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = _wrap(jnp.zeros(self._data.shape, self._data._data.dtype))
+        autograd.mark_variables([self._data], [self._grad], self.grad_req)
+
+    def _reduce(self):
+        """Return a copy on cpu (reference: parameter.py:354)."""
+        return _wrap(self.data()._data)
+
+    # ---------------------------------------------------------------- public
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter and gradient arrays
+        (reference: parameter.py:361)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            self._ctx_list = list(ctx)
+            self._data._data = jnp.asarray(self._data._data)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError("Cannot reset context for Parameter '%s' because "
+                             "it has not been initialized." % self.name)
+
+    def set_data(self, data):
+        """Set this parameter's value everywhere (reference: parameter.py:439)."""
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+            return
+        val = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        self._data._data = jnp.asarray(val, self._data._data.dtype)
+
+    def row_sparse_data(self, row_id):
+        return self.data()
+
+    def list_row_sparse_data(self, row_id):
+        return self.list_data()
+
+    def data(self, ctx=None):
+        """Return a (the) copy of this parameter (reference: parameter.py:493)."""
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        d = self._check_and_get(self._data, None)
+        return [d] * max(1, len(self._ctx_list or []))
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' "
+                "because grad_req='null'" % (self.name,))
+        self._check_and_get(self._data, ctx)
+        return self._grad
+
+    def list_grad(self):
+        g = self.grad()
+        return [g] * max(1, len(self._ctx_list or []))
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter '%s' has not been initialized" % self.name)
+        return self._ctx_list or [current_context()]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def var(self):
+        """Symbol representing this parameter (reference: parameter.py:584)."""
+        if self._var is None:
+            from ..symbol import var
+            self._var = var(self.name, shape=self.shape, dtype=self.dtype,
+                            lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                            init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self._dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data._data = jnp.asarray(self._data._data, dtype_np(dtype))
+            if self._grad is not None:
+                self._grad._data = jnp.asarray(self._grad._data, dtype_np(dtype))
+                autograd.mark_variables([self._data], [self._grad], self.grad_req)
+
+
+class Constant(Parameter):
+    """A constant parameter for values that don't change during training
+    (reference: gluon/parameter.py:636)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = ndarray_mod.array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self, _name, _key, _shape, _dtype):
+                return jnp.asarray(value._data, dtype_np(_dtype))
+
+        init_name = "Constant_{}_{}".format(name, id(self))
+        initializer._INIT_REGISTRY[init_name.lower()] = Init
+        super().__init__(
+            name, grad_req="null", shape=value.shape, dtype=value.dtype,
+            init=init_name.lower())
+
+    def generate(self, key, shape, dtype="float32", name=""):
+        return jnp.asarray(self.value._data, dtype_np(dtype))
+
+
+class ParameterDict:
+    """A dictionary managing a set of parameters
+    (reference: gluon/parameter.py:694)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            [repr(v).replace("\n", "\n  ") for v in self.values()]))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a Parameter prefixed with this dict's prefix
+        (reference: parameter.py:740)."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 > 0 and dim2 > 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 in (0, -1):
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    assert v is None or str(v) == str(existing), \
+                        "Cannot retrieve Parameter '%s' because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "'%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        """Retrieve or create a Constant (reference: parameter.py:791)."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    "No constant named '{}'. Please specify value "
+                    "if you want to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                "Parameter '{}' already exists but it is not a constant.".format(name)
+            if isinstance(value, NDArray):
+                value = value.asnumpy()
+            assert param.shape == tuple(value.shape) and \
+                _np.array_equal(param.value.asnumpy(), value), \
+                "Constant '{}' already exists but its value doesn't match new value".format(name)
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in ``other`` into self."""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for v in self.values():
+            s.update(v.list_ctx())
+        return sorted(s, key=str)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'" % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        ndarray_mod.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not "\
+                    "start with '%s'" % (restore_prefix, name, restore_prefix)
+        lprefix = len(restore_prefix)
+        loaded = ndarray_mod.load(filename)
+        if not isinstance(loaded, dict):
+            raise ValueError("Expected a dict of arrays in %s" % filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
+                                  dtype_source=dtype_source)

@@ -1,0 +1,245 @@
+"""Trainer — applies an Optimizer to a set of Parameters.
+
+Reference: ``python/mxnet/gluon/trainer.py:27`` — holds parameters, creates a
+kvstore via model._create_kvstore, allreduces grads then updates (step/
+allreduce/update :305-399), with update_on_kvstore placement semantics.
+
+On TPU the kvstore reduce is an XLA collective (or identity on one chip); the
+priority-ordered async push/pull of the reference (priority=-param_index,
+trainer.py:360) is subsumed by XLA's compiler-scheduled overlap.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..kvstore import create as _create_kvstore_mod
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict, ParameterDict)):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init else [None]
+            contexts = contexts or ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _reset_kvstore(self):
+        if self._kvstore and "dist" in self._kvstore.type:
+            raise RuntimeError(
+                "Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore:
+            kv = _create_kvstore_mod(kvstore) if isinstance(kvstore, str) else kvstore
+            if update_on_kvstore is None:
+                # single-chip / single-process: updating locally is the fast
+                # path (no server round trip) — matches _create_kvstore logic
+                # in python/mxnet/model.py
+                update_on_kvstore = "dist" in kv.type
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            self._kvstore = kv
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized, \
+            "Cannot initialize parameters in KVStore when KVStore is not " \
+            "initialized."
+        params_to_init = []
+        if self._kvstore:
+            for param in self._params_to_init:
+                if param._deferred_init:
+                    params_to_init.append(param)
+                else:
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param.data())
+        self._params_to_init = params_to_init
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate can be "
+                "accessed.")
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        if isinstance(self._optimizer, opt.Optimizer):
+            return self._optimizer
+        raise UserWarning("Optimizer has not been initialized yet")
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate is "
+                "mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Makes one step of parameter update
+        (reference: trainer.py:305)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kv_initialized and self._kvstore:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    "Possible change in the `batch_size` from previous "
+                    "`step` detected. Optimizer gradient normalizing factor "
+                    "will not change w.r.t new batch_size when "
+                    "update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Reduce gradients over devices/workers without updating
+        (reference: trainer.py:335)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    if self._update_on_kvstore:
+                        self._kvstore.pushpull(
+                            i, param.grad(), out=param.data(), priority=-i)
+                    else:
+                        grads = param.list_grad()
+                        self._kvstore.push(i, grads, priority=-i)
+                        self._kvstore.pull(i, grads, priority=-i,
+                                           ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Updates parameters using already-reduced gradients
+        (reference: trainer.py:374)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._kvstore and self._update_on_kvstore:
+            return
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            updater(i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """Saves trainer (optimizer) states to a file
+        (reference: trainer.py:436)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not " \
+                "yet initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Loads trainer (optimizer) states from a file
+        (reference: trainer.py:465)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater_obj.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            self._updaters[0].set_states(states)
+            self._updaters[0].optimizer = self._optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

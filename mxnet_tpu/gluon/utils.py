@@ -1,0 +1,141 @@
+"""Gluon utility functions.
+
+Reference: ``python/mxnet/gluon/utils.py`` — split_data/split_and_load for
+multi-device data parallelism, clip_global_norm, download/check_sha1 helpers.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as _np
+
+from ..context import Context, cpu
+from ..ndarray.ndarray import NDArray, _wrap, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Splits an NDArray into num_slice slices along batch_axis
+    (reference: gluon/utils.py:36)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Splits an NDArray into len(ctx_list) slices and loads each onto a
+    context (reference: gluon/utils.py:85).
+
+    On TPU, sharded SPMD execution supersedes per-context splits; with one
+    logical device this is identity placement, preserving script parity.
+    """
+    if not isinstance(data, NDArray):
+        data = nd_array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescales NDArrays so that the sum of their 2-norm is smaller than
+    max_norm (reference: gluon/utils.py:115)."""
+    import jax.numpy as jnp
+
+    def _norm(arr):
+        return jnp.sum(jnp.square(arr._data.ravel()))
+
+    assert len(arrays) > 0
+    total_norm = jnp.sqrt(sum(_norm(arr) for arr in arrays))
+    if check_isfinite:
+        tn = float(total_norm)
+        if not _np.isfinite(tn):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will be "
+                            "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    scale = jnp.minimum(scale, 1.0)
+    for arr in arrays:
+        arr._data = arr._data * scale.astype(arr._data.dtype)
+    if check_isfinite:
+        return float(total_norm)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check whether the sha1 hash of the file content matches
+    (reference: gluon/utils.py:165)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file from a URL (reference: gluon/utils.py:190).
+
+    This build targets air-gapped TPU pods: no network egress.  Files must be
+    staged locally; a missing file raises with instructions.
+    """
+    fname = path
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    if os.path.exists(fname) and (not overwrite) and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%s) unavailable: this environment has no network egress. "
+        "Stage the file at %r manually." % (url, fname))
+
+
+def shape_is_known(shape):
+    """Check whether a shape is completely known with or without np semantics
+    (reference: gluon/utils.py:413)."""
+    if shape is None:
+        return False
+    unknown_dim_size = 0
+    if len(shape) == 0:
+        return True
+    for dim_size in shape:
+        if dim_size == unknown_dim_size:
+            return False
+        assert dim_size > unknown_dim_size, \
+            "shape dimension size cannot be less than {}, while received {}".format(
+                unknown_dim_size, dim_size)
+    return True
+
+
+def _indent(s_, numSpaces):
+    s = s_.split("\n")
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(numSpaces * " ") + line for line in s]
+    return "\n".join(s)

@@ -1,0 +1,322 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` — an ``Initializer`` registry keyed
+by lowercase class name; descriptors (``InitDesc``) carry the parameter name so
+pattern-based init (``Mixed``) and attribute-driven init (``__init__`` attrs)
+can dispatch.  Re-designed here on ``jax.random``: every initializer is a pure
+function of an explicit PRNG key, shape and dtype, so parameter init is
+reproducible and traceable (can run inside jit for sharded init).
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import dtype_np
+from . import random as _random
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "Mixed", "Load"]
+
+_INIT_REGISTRY = {}
+
+
+class InitDesc(str):
+    """Parameter name + attrs descriptor (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+_INIT_ALIASES = {"zero": ("zeros",), "one": ("ones",),
+                 "normal": ("gaussian",)}
+
+
+def register(klass):
+    name = klass.__name__.lower()
+    _INIT_REGISTRY[name] = klass
+    for alias in _INIT_ALIASES.get(name, ()):
+        _INIT_REGISTRY[alias] = klass
+    return klass
+
+
+def create(initializer, **kwargs):
+    """Create initializer from str name / instance / None."""
+    if initializer is None:
+        return Uniform()
+    if isinstance(initializer, Initializer):
+        return initializer
+    if isinstance(initializer, str):
+        name = initializer.lower()
+        if name not in _INIT_REGISTRY:
+            raise ValueError("unknown initializer %r" % initializer)
+        return _INIT_REGISTRY[name](**kwargs)
+    raise TypeError("cannot create initializer from %r" % (initializer,))
+
+
+class Initializer:
+    """Base initializer.
+
+    Subclasses implement ``_init_weight(name, key, shape, dtype) -> jax array``.
+    Calling convention matches the reference (``init(desc, arr)`` mutates arr),
+    plus a functional ``generate(key, shape, dtype)`` used by Gluon Parameter.
+    """
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__
+                and self._kwargs == getattr(other, "_kwargs", None))
+
+    def __repr__(self):
+        return self.dumps()
+
+    # -------------------------------------------------------- reference API
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be str or InitDesc")
+        if isinstance(desc, InitDesc) and desc.global_init is None:
+            desc.global_init = self
+        init_name = desc.attrs.get("__init__", "") if isinstance(desc, InitDesc) else ""
+        if init_name:
+            create(json.loads(init_name)[0], **json.loads(init_name)[1])._init(
+                str(desc), arr)
+        else:
+            self._init(str(desc), arr)
+
+    init = __call__
+
+    def _init(self, name, arr):
+        val = self.generate(_random.new_eager_seed_key(), arr.shape,
+                            arr.dtype, name=name)
+        arr._set_data(jnp.asarray(val, dtype=arr.dtype))
+
+    # -------------------------------------------------------- functional API
+    def generate(self, key, shape, dtype="float32", name=""):
+        """Pure: produce the initial value as a jax array."""
+        name = name or ""
+        # name-based dispatch mirrors the reference's suffix rules
+        if name.endswith("gamma"):
+            return self._init_one(shape, dtype)
+        if name.endswith("beta") or name.endswith("bias"):
+            return self._init_zero(shape, dtype)
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return self._init_zero(shape, dtype)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return self._init_one(shape, dtype)
+        return self._init_weight(name, key, shape, dtype)
+
+    @staticmethod
+    def _init_zero(shape, dtype):
+        return jnp.zeros(shape, dtype_np(dtype))
+
+    @staticmethod
+    def _init_one(shape, dtype):
+        return jnp.ones(shape, dtype_np(dtype))
+
+    def _init_weight(self, name, key, shape, dtype):
+        raise NotImplementedError
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, key, shape, dtype):
+        return jnp.zeros(shape, dtype_np(dtype))
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, key, shape, dtype):
+        return jnp.ones(shape, dtype_np(dtype))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype_np(dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype_np(dtype),
+                                  minval=-self.scale, maxval=self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, key, shape, dtype):
+        return self.sigma * jax.random.normal(key, shape, dtype_np(dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, key, shape, dtype):
+        nout = shape[0]
+        nin = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == (nout, nin) else v
+        return (self.scale * res).reshape(shape).astype(dtype_np(dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Reference: initializer.py Xavier — factor from fan_in/fan_out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer needs >=2D shape for %r, got %s" % (name, shape))
+        hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            return jax.random.uniform(key, shape, dtype_np(dtype),
+                                      minval=-scale, maxval=scale)
+        if self.rnd_type == "gaussian":
+            return scale * jax.random.normal(key, shape, dtype_np(dtype))
+        raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py Bilinear)."""
+
+    def _init_weight(self, name, key, shape, dtype):
+        weight = _np.zeros(int(_np.prod(shape)), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype_np(dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, key, shape, dtype):
+        b = _np.zeros(shape, dtype="float32")
+        num_hidden = int(shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        return jnp.asarray(b, dtype_np(dtype))
+
+
+class Mixed:
+    """Pattern → initializer dispatch (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers lengths differ")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern" % name)
+
+    def generate(self, key, shape, dtype="float32", name=""):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                return init.generate(key, shape, dtype, name=name)
+        raise ValueError("Parameter name %s did not match any pattern" % name)
+
+
+@register
+class Load:
+    """Init from a dict of saved arrays, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k[4:] if k.startswith("arg:") or k.startswith("aux:")
+                      else k: v for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError("Parameter %s shape mismatch" % name)
+            arr._set_data(jnp.asarray(
+                src._data if isinstance(src, NDArray) else src, dtype=arr.dtype))
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot init parameter %s from loaded file" % name)
+            self.default_init(name, arr)
+
+    def generate(self, key, shape, dtype="float32", name=""):
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            return jnp.asarray(src._data if isinstance(src, NDArray) else src,
+                               dtype=dtype_np(dtype))
+        if self.default_init is None:
+            raise ValueError("Cannot init parameter %s from loaded file" % name)
+        return self.default_init.generate(key, shape, dtype, name=name)

@@ -1,0 +1,213 @@
+"""KVStore — parameter synchronization facade.
+
+Reference: ``include/mxnet/kvstore.h:59-391`` + ``src/kvstore/`` — three
+backends behind one API: local/device (intra-process multi-GPU reduce,
+comm.h:451), NCCL (kvstore_nccl.h), and ps-lite parameter server
+(kvstore_dist.h).  ``KVStore::Create`` parses the type string
+(src/kvstore/kvstore.cc:40-72).
+
+TPU-native re-design (SURVEY.md §5.8): the whole comm stack collapses into XLA
+collectives.  Within one process all devices live under one jax namespace, so
+"reduce across device copies" is a sum over the provided arrays; across hosts
+(``dist_*``) gradients are allreduced with ``jax.lax.psum`` over the global
+mesh via ``mxnet_tpu.parallel`` (DCN-hierarchical, handled by XLA).  The
+push/pull/updater semantics — including update_on_kvstore placement, which
+affects numerics — follow kvstore_local.h:69,195-294.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray, _wrap
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStore:
+    """A key-value store for parameter synchronization
+    (reference: include/mxnet/kvstore.h:59, python/mxnet/kvstore.py:66)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._updater_obj = None
+        self._compression_params = None
+        self._is_dist = kv_type.startswith("dist")
+
+    # --------------------------------------------------------------- meta
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Worker rank (reference: KVStore::get_rank)."""
+        if self._is_dist:
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._is_dist:
+            return jax.process_count()
+        return 1
+
+    # --------------------------------------------------------------- CRUD
+    def init(self, key, value):
+        """Initializes one or more key-value pairs
+        (reference: kvstore.py:139)."""
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = _wrap(jnp.asarray(v._data))
+
+    def _merge(self, value):
+        """Reduce per-device copies — the CommDevice::Reduce analog
+        (src/kvstore/comm.h:451)."""
+        if isinstance(value, (list, tuple)):
+            merged = value[0]._data
+            for v in value[1:]:
+                merged = jnp.add(merged, v._data)
+            return merged
+        return value._data
+
+    def _allreduce_dist(self, val):
+        """Cross-process sum over DCN (ps-lite server-merge analog,
+        src/kvstore/kvstore_dist_server.h:349)."""
+        if self.num_workers == 1:
+            return val
+        from .parallel import host_allreduce
+        return host_allreduce(val)
+
+    def push(self, key, value, priority=0):
+        """Pushes (aggregates) value(s) into the store
+        (reference: kvstore.py:178; KVStoreLocal::PushImpl kvstore_local.h:206).
+        """
+        keys, values = _normalize_push(key, value)
+        for k, v in zip(keys, values):
+            merged = self._merge(v)
+            merged = self._allreduce_dist(merged)
+            if self._updater is not None:
+                self._updater(_key_int(k), _wrap(merged), self._store[k])
+            else:
+                self._store[k]._data = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pulls value(s) from the store into out
+        (reference: kvstore.py:248)."""
+        assert out is not None
+        keys, outs = _normalize_push(key, out)
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.asarray(src._data, t._data.dtype)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Combined push and pull (reference: kvstore.py:290)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+        else:
+            self.pull(key, value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Row-sparse pull; dense fallback gathers the requested rows
+        (reference: kvstore.py:318)."""
+        assert out is not None
+        self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    # ----------------------------------------------------------- optimizer
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression facade (reference:
+        src/kvstore/gradient_compression.cc:60).  ICI/DCN allreduce bandwidth
+        makes compression counterproductive on TPU; recorded for parity."""
+        self._compression_params = compression_params
+
+    def set_optimizer(self, optimizer):
+        """Registers an optimizer so updates run "on kvstore" — the
+        update_on_kvstore path (reference: kvstore.py:399)."""
+        self._optimizer = optimizer
+        self._updater_obj = opt.get_updater(optimizer)
+        self._updater = self._updater_obj
+
+    def set_updater(self, updater):
+        """Sets a push updater (reference: kvstore.py:512)."""
+        self._updater = updater
+        if isinstance(updater, opt.Updater):
+            self._updater_obj = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater_obj is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater_obj.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater_obj is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater_obj.set_states(fin.read())
+
+    # ----------------------------------------------------------- dist sync
+    def barrier(self):
+        """Global barrier across workers (reference: KVStore::Barrier)."""
+        if self._is_dist and self.num_workers > 1:
+            from .parallel import host_barrier
+            host_barrier()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        keys = [_key_str(k) for k in key]
+        values = list(value)
+    else:
+        keys = [_key_str(key)]
+        values = [value]
+    return keys, values
+
+
+def _normalize_push(key, value):
+    if isinstance(key, (list, tuple)):
+        keys = [_key_str(k) for k in key]
+        values = list(value)
+    else:
+        keys = [_key_str(key)]
+        values = [value]
+    return keys, values
+
+
+def create(name="local"):
+    """Creates a KVStore (reference: python/mxnet/kvstore.py:649;
+    type parsing src/kvstore/kvstore.cc:40-72)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+             "dist_async", "dist_sync_device", "local_allreduce_cpu",
+             "local_allreduce_device")
+    if name not in valid:
+        raise ValueError("Unknown KVStore type %r" % name)
+    return KVStore(name)

@@ -1,0 +1,823 @@
+"""Evaluation metrics.
+
+Reference: ``python/mxnet/metric.py`` — ``EvalMetric`` registry with
+Accuracy/TopK/F1/MCC/MAE/MSE/RMSE/CrossEntropy/NLL/Pearson/Perplexity/
+Composite/Custom metrics, updated per batch by ``Module.update_metric`` or user
+loops.  Metric math runs on host numpy: metric updates are small reductions
+over already-materialized outputs, so keeping them off-device avoids recompiles
+and device syncs in the training hot loop (compute the network on TPU, reduce
+the scalar on host).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "Caffe", "CustomMetric", "np", "create", "register"]
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _alias(*names):
+    def deco(klass):
+        for n in names:
+            _METRIC_REGISTRY[n.lower()] = klass
+        return klass
+    return deco
+
+
+def create(metric, *args, **kwargs):
+    """Create metric from name / callable / list / instance."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    if isinstance(metric, str):
+        name = metric.lower()
+        if name not in _METRIC_REGISTRY:
+            raise ValueError("Metric must be either callable or in registry; "
+                             "got %s" % metric)
+        return _METRIC_REGISTRY[name](*args, **kwargs)
+    raise TypeError("metric should be str, callable, list or EvalMetric")
+
+
+def _as_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return numpy.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels {} does not match shape of "
+                         "predictions {}".format(label_shape, pred_shape))
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """Base metric (reference: metric.py:43)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._has_global_stats = kwargs.pop("has_global_stats", False)
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({
+            "metric": self.__class__.__name__,
+            "name": self.name,
+            "output_names": self.output_names,
+            "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self._has_global_stats:
+            if self.global_num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.global_sum_metric / self.global_num_inst)
+        return self.get()
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        if self._has_global_stats:
+            name, value = self.get_global()
+            if not isinstance(name, list):
+                name = [name]
+            if not isinstance(value, list):
+                value = [value]
+            return list(zip(name, value))
+        return self.get_name_value()
+
+    def _update(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+
+@register
+@_alias("composite")
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference: metric.py:369)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(i) for i in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}".format(
+                index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        if self.label_names is not None:
+            labels = {name: label for name, label in zip(self.label_names, labels)}
+        if self.output_names is not None:
+            preds = {name: pred for name, pred in zip(self.output_names, preds)}
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_global(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get_global()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        return config
+
+
+@register
+@_alias("acc")
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference: metric.py:493)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, axis=axis, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pred_label = _as_numpy(pred_label)
+            label = _as_numpy(label)
+            if pred_label.ndim > label.ndim:
+                pred_label = numpy.argmax(pred_label, axis=self.axis)
+            pred_label = pred_label.astype("int32").ravel()
+            label = label.astype("int32").ravel()
+            check_label_shapes(label, pred_label)
+            num_correct = (pred_label == label).sum()
+            self._update(float(num_correct), len(pred_label))
+
+
+@register
+@_alias("top_k_accuracy", "top_k_acc")
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference: metric.py:560)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, top_k=top_k, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
+            pred_label = numpy.argsort(-_as_numpy(pred_label).astype("float32"),
+                                       axis=-1, kind="stable")
+            label = _as_numpy(label).astype("int32")
+            check_label_shapes(label, pred_label)
+            num_samples = pred_label.shape[0]
+            num_dims = len(pred_label.shape)
+            if num_dims == 1:
+                num_correct = (pred_label.ravel() == label.ravel()).sum()
+                self._update(float(num_correct), 0)
+            elif num_dims == 2:
+                num_classes = pred_label.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    num_correct = (pred_label[:, j].ravel() == label.ravel()).sum()
+                    self._update(float(num_correct), 0)
+            self._update(0.0, num_samples)
+
+
+class _BinaryClassificationMetrics:
+    """Running TP/FP/TN/FN tallies (reference: metric.py:640)."""
+
+    def __init__(self):
+        self.reset_stats()
+
+    def update_binary_stats(self, label, pred):
+        pred = _as_numpy(pred)
+        label = _as_numpy(label).astype("int32")
+        pred_label = numpy.argmax(pred, axis=1)
+        check_label_shapes(label, pred)
+        if len(numpy.unique(label)) > 2:
+            raise ValueError("%s currently only supports binary classification."
+                             % self.__class__.__name__)
+        pred_true = (pred_label == 1)
+        pred_false = 1 - pred_true
+        label_true = (label == 1)
+        label_false = 1 - label_true
+
+        true_pos = (pred_true * label_true).sum()
+        false_pos = (pred_true * label_false).sum()
+        false_neg = (pred_false * label_true).sum()
+        true_neg = (pred_false * label_false).sum()
+        self.true_positives += true_pos
+        self.global_true_positives += true_pos
+        self.false_positives += false_pos
+        self.global_false_positives += false_pos
+        self.false_negatives += false_neg
+        self.global_false_negatives += false_neg
+        self.true_negatives += true_neg
+        self.global_true_negatives += true_neg
+
+    @property
+    def precision(self):
+        if self.true_positives + self.false_positives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_positives)
+        return 0.0
+
+    @property
+    def global_precision(self):
+        if self.global_true_positives + self.global_false_positives > 0:
+            return float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_positives)
+        return 0.0
+
+    @property
+    def recall(self):
+        if self.true_positives + self.false_negatives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_negatives)
+        return 0.0
+
+    @property
+    def global_recall(self):
+        if self.global_true_positives + self.global_false_negatives > 0:
+            return float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_negatives)
+        return 0.0
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (self.precision + self.recall)
+        return 0.0
+
+    @property
+    def global_fscore(self):
+        if self.global_precision + self.global_recall > 0:
+            return (2 * self.global_precision * self.global_recall
+                    / (self.global_precision + self.global_recall))
+        return 0.0
+
+    def matthewscc(self, use_global=False):
+        if use_global:
+            if not self.global_total_examples:
+                return 0.0
+            true_pos = float(self.global_true_positives)
+            false_pos = float(self.global_false_positives)
+            false_neg = float(self.global_false_negatives)
+            true_neg = float(self.global_true_negatives)
+        else:
+            if not self.total_examples:
+                return 0.0
+            true_pos = float(self.true_positives)
+            false_pos = float(self.false_positives)
+            false_neg = float(self.false_negatives)
+            true_neg = float(self.true_negatives)
+        terms = [(true_pos + false_pos),
+                 (true_pos + false_neg),
+                 (true_neg + false_pos),
+                 (true_neg + false_neg)]
+        denom = 1.0
+        for t in filter(lambda t: t != 0.0, terms):
+            denom *= t
+        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+
+    @property
+    def total_examples(self):
+        return (self.false_negatives + self.false_positives
+                + self.true_negatives + self.true_positives)
+
+    @property
+    def global_total_examples(self):
+        return (self.global_false_negatives + self.global_false_positives
+                + self.global_true_negatives + self.global_true_positives)
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+        self.global_false_positives = 0
+        self.global_false_negatives = 0
+        self.global_true_positives = 0
+        self.global_true_negatives = 0
+
+    def local_reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.py:761)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(label, pred)
+        if self.average == "macro":
+            self.sum_metric += self.metrics.fscore
+            self.global_sum_metric += self.metrics.global_fscore
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self.metrics.local_reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.global_sum_metric = (self.metrics.global_fscore
+                                      * self.metrics.global_total_examples)
+            self.num_inst = self.metrics.total_examples
+            self.global_num_inst = self.metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self.global_num_inst = 0.0
+        self.global_sum_metric = 0.0
+        self.metrics.reset_stats()
+
+    def reset_local(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self.metrics.local_reset_stats()
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference: metric.py:838)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        self._average = average
+        self._metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._metrics.update_binary_stats(label, pred)
+        if self._average == "macro":
+            self.sum_metric += self._metrics.matthewscc()
+            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self._metrics.local_reset_stats()
+        else:
+            self.sum_metric = (self._metrics.matthewscc()
+                               * self._metrics.total_examples)
+            self.global_sum_metric = (self._metrics.matthewscc(use_global=True)
+                                      * self._metrics.global_total_examples)
+            self.num_inst = self._metrics.total_examples
+            self.global_num_inst = self._metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self.global_sum_metric = 0.0
+        self.global_num_inst = 0.0
+        self._metrics.reset_stats()
+
+    def reset_local(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self._metrics.local_reset_stats()
+
+
+@register
+class Perplexity(EvalMetric):
+    """Perplexity (reference: metric.py:941)."""
+
+    def __init__(self, ignore_label, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, ignore_label=ignore_label, axis=axis,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            assert label.size == pred.size / pred.shape[-1], \
+                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+            label = label.reshape((label.size,)).astype("int32")
+            probs = pred.reshape(-1, pred.shape[-1])[
+                numpy.arange(label.size), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label).astype(probs.dtype)
+                num -= int(numpy.sum(ignore))
+                probs = probs * (1 - ignore) + ignore
+            loss -= float(numpy.sum(numpy.log(numpy.maximum(1e-10, probs))))
+            num += label.size
+        self._update(loss, num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (reference: metric.py:1025)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            num = len(pred)
+            mae = numpy.abs(label - pred).mean()
+            self._update(mae * num, num)
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (reference: metric.py:1079)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            num = len(pred)
+            mse = ((label - pred) ** 2.0).mean()
+            self._update(mse * num, num)
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (reference: metric.py:1133)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            num = len(pred)
+            rmse = numpy.sqrt(((label - pred) ** 2.0).mean())
+            self._update(rmse * num, num)
+
+
+@register
+@_alias("ce")
+class CrossEntropy(EvalMetric):
+    """Cross-entropy of predicted probabilities (reference: metric.py:1188)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
+            cross_entropy = (-numpy.log(prob + self.eps)).sum()
+            self._update(cross_entropy, label.shape[0])
+
+
+@register
+@_alias("nll_loss")
+class NegativeLogLikelihood(EvalMetric):
+    """NLL (reference: metric.py:1254)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            label = label.ravel()
+            num_examples = pred.shape[0]
+            assert label.shape[0] == num_examples, (label.shape[0], num_examples)
+            prob = pred[numpy.arange(num_examples), numpy.int64(label)]
+            nll = (-numpy.log(prob + self.eps)).sum()
+            self._update(nll, num_examples)
+
+
+@register
+@_alias("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (reference: metric.py:1320)."""
+
+    def __init__(self, name="pearsonr", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        if self.average == "micro":
+            self.reset_micro()
+
+    def reset_micro(self):
+        self._sse_p = 0
+        self._mean_p = 0
+        self._sse_l = 0
+        self._mean_l = 0
+        self._pred_nums = 0
+        self._label_nums = 0
+        self._conv = 0
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+        if getattr(self, "average", None) == "micro":
+            self.reset_micro()
+
+    def update_variance(self, new_values, *aggregate):
+        count = len(new_values)
+        mean = numpy.mean(new_values)
+        variance = numpy.sum((new_values - mean) ** 2)
+        count_a, mean_a, var_a = aggregate
+        delta = mean - mean_a
+        m_a = var_a * (count_a - 1)
+        m_b = variance * (count - 1)
+        M2 = m_a + m_b + delta ** 2 * count_a * count / (count_a + count)
+        count_a += count
+        mean_a = (count_a * mean_a + count * mean) / count_a
+        var_a = M2 / (count_a - 1)
+        return count_a, mean_a, var_a
+
+    def update_cov(self, label, pred):
+        self._conv = self._conv + numpy.sum(
+            (label - self._mean_l) * (pred - self._mean_p))
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            check_label_shapes(label, pred, False, True)
+            label = _as_numpy(label).ravel().astype(numpy.float64)
+            pred = _as_numpy(pred).ravel().astype(numpy.float64)
+            if self.average == "macro":
+                pearson_corr = numpy.corrcoef(pred, label)[0, 1]
+                self._update(pearson_corr, 1)
+            else:
+                self.global_num_inst += 1
+                self.num_inst += 1
+                self._label_nums, self._mean_l, self._sse_l = \
+                    self.update_variance(label, self._label_nums,
+                                         self._mean_l, self._sse_l)
+                self.update_cov(label, pred)
+                self._pred_nums, self._mean_p, self._sse_p = \
+                    self.update_variance(pred, self._pred_nums,
+                                         self._mean_p, self._sse_p)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "macro":
+            return (self.name, self.sum_metric / self.num_inst)
+        n = self._label_nums
+        numerator = self._conv
+        denominator = (n - 1) * numpy.sqrt(self._sse_p) * numpy.sqrt(self._sse_l)
+        pearsonr = numerator / denominator
+        return (self.name, pearsonr)
+
+
+@register
+class Loss(EvalMetric):
+    """Dummy metric averaging a loss output (reference: metric.py:1439)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            loss = float(numpy.sum(_as_numpy(pred)))
+            self._update(loss, pred.size)
+
+
+@register
+class Torch(Loss):
+    """Compat alias (reference: metric.py:1466)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Compat alias (reference: metric.py:1474)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Metric from a feval function (reference: metric.py:1482)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self._update(sum_metric, num_inst)
+            else:
+                self._update(reval, 1)
+
+    def get_config(self):
+        raise NotImplementedError("CustomMetric cannot be serialized")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval as a metric (reference: metric.py:1551)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,64 @@
+"""Automatic naming manager (reference: python/mxnet/name.py)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class _ClassProperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+class NameManager:
+    """NameManager to do automatic naming (reference: name.py:27)."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    @_ClassProperty
+    def current(cls):
+        if not hasattr(NameManager._state, "value") or \
+                NameManager._state.value is None:
+            NameManager._state.value = NameManager()
+        return NameManager._state.value
+
+    def get(self, name, hint):
+        """Get the canonical name for a symbol."""
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "value"):
+            NameManager._state.value = None
+        self._old_manager = NameManager._state.value
+        NameManager._state.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        NameManager._state.value = self._old_manager
+
+
+class Prefix(NameManager):
+    """A name manager that attaches a prefix to all names
+    (reference: name.py:83)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -1,0 +1,181 @@
+"""Control-flow operators: foreach, while_loop, cond.
+
+Reference: ``src/operator/control_flow.cc:1089-1255`` — `_foreach`,
+`_while_loop`, `_cond` run a sub-graph per iteration with state threading;
+Python frontend ``python/mxnet/ndarray/contrib.py`` (foreach :216,
+while_loop :340, cond :480).
+
+TPU-native: under a trace (hybridized/jit) these lower to ``lax.scan`` /
+``lax.while_loop`` / ``lax.cond`` — XLA's native loops.  In eager recording
+mode they run as Python loops so the autograd tape sees each step (the
+reference's imperative path does the same graph-per-step execution).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import _tape
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _to_nd(x):
+    from ..ndarray.ndarray import NDArray, _wrap
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_nd(i) for i in x)
+    if isinstance(x, NDArray):
+        return x
+    return _wrap(jnp.asarray(x))
+
+
+def _to_val(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_val(i) for i in x)
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def _eager_like():
+    """True when we should run python-level loops (tape active)."""
+    return _tape.is_recording()
+
+
+def foreach(body, data, init_states):
+    """Run body over the leading axis of data, threading states
+    (reference: contrib.py foreach :216).
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    datas = [data] if single_data else list(data)
+    states = [init_states] if single_state else list(init_states)
+
+    if _eager_like():
+        outputs = []
+        n = datas[0].shape[0]
+        for i in range(n):
+            eles = [d[i] for d in datas]
+            eles = eles[0] if single_data else eles
+            outs, states_out = body(eles, states[0] if single_state else states)
+            states = [states_out] if single_state else list(states_out)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            outputs.append(outs)
+        from ..ops.registry import invoke
+        stacked = [invoke("stack", *[o[j] for o in outputs], axis=0)
+                   for j in range(len(outputs[0]))]
+        out = stacked[0] if len(stacked) == 1 else stacked
+        final_states = states[0] if single_state else states
+        return out, final_states
+
+    # traced path: lax.scan over jax values
+    def scan_body(carry, xs):
+        carry_nd = [_wrap(c) for c in carry]
+        xs_nd = [_wrap(x) for x in xs]
+        outs, new_states = body(xs_nd[0] if single_data else xs_nd,
+                                carry_nd[0] if single_state else carry_nd)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if isinstance(new_states, NDArray):
+            new_states = [new_states]
+        return tuple(_to_val(s) for s in new_states), tuple(_to_val(o) for o in outs)
+
+    carry0 = tuple(_to_val(s) for s in states)
+    xs_vals = tuple(_to_val(d) for d in datas)
+    final_carry, outs = lax.scan(scan_body, carry0, xs_vals)
+    outs_nd = [_wrap(o) for o in outs]
+    states_nd = [_wrap(c) for c in final_carry]
+    out = outs_nd[0] if len(outs_nd) == 1 else outs_nd
+    final_states = states_nd[0] if single_state else states_nd
+    return out, final_states
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """while_loop with max_iterations bound
+    (reference: contrib.py while_loop :340).
+
+    Returns (outputs, final_loop_vars).  Like the reference, outputs are
+    stacked per-step results padded to max_iterations.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    single_var = isinstance(loop_vars, NDArray)
+    lvars = [loop_vars] if single_var else list(loop_vars)
+    if max_iterations is None:
+        raise ValueError("max_iterations should be specified")
+
+    if _eager_like():
+        steps = 0
+        outputs = []
+        while steps < max_iterations and bool(
+                cond_fn(*lvars).asscalar() if isinstance(
+                    cond_fn(*lvars), NDArray) else cond_fn(*lvars)):
+            step_out, lvars = func(*lvars)
+            if not isinstance(step_out, (list, tuple)):
+                step_out = [step_out]
+            lvars = [lvars] if isinstance(lvars, NDArray) else list(lvars)
+            outputs.append(step_out)
+            steps += 1
+        from ..ops.registry import invoke
+        if outputs:
+            stacked = [invoke("stack", *[o[j] for o in outputs], axis=0)
+                       for j in range(len(outputs[0]))]
+        else:
+            stacked = []
+        out = stacked[0] if len(stacked) == 1 else stacked
+        return out, (lvars[0] if single_var else lvars)
+
+    # traced: fixed-trip scan with predicate masking (keeps shapes static,
+    # the XLA-friendly formulation of a bounded while)
+    def scan_body(carry, _):
+        alive, vals = carry
+        vals_nd = [_wrap(v) for v in vals]
+        pred = cond_fn(*vals_nd)
+        pred = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+        alive_now = jnp.logical_and(alive, pred.astype(bool).reshape(()))
+        step_out, new_vals = func(*vals_nd)
+        if not isinstance(step_out, (list, tuple)):
+            step_out = [step_out]
+        if isinstance(new_vals, NDArray):
+            new_vals = [new_vals]
+        new_vals = tuple(
+            jnp.where(alive_now, _to_val(nv), v)
+            for nv, v in zip(new_vals, vals))
+        outs = tuple(_to_val(o) for o in step_out)
+        return (alive_now, new_vals), outs
+
+    carry0 = (jnp.asarray(True), tuple(_to_val(v) for v in lvars))
+    (alive, final_vals), outs = lax.scan(scan_body, carry0, None,
+                                         length=int(max_iterations))
+    outs_nd = [_wrap(o) for o in outs]
+    vars_nd = [_wrap(v) for v in final_vals]
+    out = outs_nd[0] if len(outs_nd) == 1 else outs_nd
+    return out, (vars_nd[0] if single_var else vars_nd)
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else (reference: contrib.py cond :480)."""
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    if _eager_like():
+        p = pred.asscalar() if isinstance(pred, NDArray) else pred
+        return then_func() if p else else_func()
+
+    pv = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+
+    def _then(_):
+        out = then_func()
+        return tuple(_to_val(o) for o in (out if isinstance(out, (list, tuple)) else [out]))
+
+    def _else(_):
+        out = else_func()
+        return tuple(_to_val(o) for o in (out if isinstance(out, (list, tuple)) else [out]))
+
+    outs = lax.cond(pv.astype(bool).reshape(()), _then, _else, operand=None)
+    outs_nd = [_wrap(o) for o in outs]
+    return outs_nd[0] if len(outs_nd) == 1 else outs_nd

@@ -1,0 +1,144 @@
+"""Recurrent ops.
+
+Reference: the fused stateful RNN operator ``src/operator/rnn.cc:652`` with
+cuDNN path ``src/operator/rnn-inl.h:427`` — modes rnn_relu/rnn_tanh/lstm/gru,
+multi-layer, bidirectional, TNC layout.
+
+TPU-native: recurrence is a ``lax.scan`` over time — the idiomatic XLA
+compiler-friendly control flow (SURVEY.md §7 stage 9).  The per-step cell is a
+pair of MXU matmuls; XLA hoists the weight transposes and fuses the gate math.
+Layers/directions unroll in Python (static), matching how cuDNN internally
+iterates layers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b):
+    """One timestep given precomputed input projection x_proj."""
+    hp = jnp.dot(h, h2h_w.T) + h2h_b
+    if mode == "rnn_relu":
+        return jax.nn.relu(x_proj + hp), c
+    if mode == "rnn_tanh":
+        return jnp.tanh(x_proj + hp), c
+    if mode == "lstm":
+        gates = x_proj + hp
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        return o * jnp.tanh(c_new), c_new
+    if mode == "gru":
+        # reference/cuDNN gate order: reset, update, new
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1.0 - z) * n + z * h, c
+    raise ValueError("unknown RNN mode %r" % mode)
+
+
+def rnn_layer_scan(data, i2h_w, i2h_b, h2h_w, h2h_b, h0, c0, mode,
+                   reverse=False):
+    """Scan one direction of one layer.  data: (T, B, I); returns
+    (out (T,B,H), h_T, c_T)."""
+    x = jnp.asarray(data)
+    # hoist the input projection out of the scan: one big MXU matmul over
+    # (T*B, I) instead of T small ones
+    x_proj = jnp.dot(x, jnp.asarray(i2h_w).T) + jnp.asarray(i2h_b)
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=0)
+
+    def step(carry, xp):
+        h, c = carry
+        h_new, c_new = _cell_step(mode, xp, h, c, jnp.asarray(h2h_w),
+                                  jnp.asarray(h2h_b))
+        return (h_new, c_new), h_new
+
+    (h_t, c_t), out = lax.scan(step, (jnp.asarray(h0), jnp.asarray(c0)), x_proj)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, h_t, c_t
+
+
+@register("RNN", num_outputs=3)
+def _rnn(data, parameters, state, state_cell=None, state_size=None,
+         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+         state_outputs=True, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, training=False, use_sequence_length=False,
+         sequence_length=None, **_):
+    """Fused multi-layer RNN (reference: src/operator/rnn.cc:652).
+
+    data: (T, B, I); parameters: flat 1-D cuDNN-layout weights; state:
+    (L*D, B, H); state_cell for lstm.  Returns (out, h_n[, c_n]).
+    """
+    x = jnp.asarray(data)
+    w = jnp.asarray(parameters)
+    h0_all = jnp.asarray(state)
+    c0_all = jnp.asarray(state_cell) if state_cell is not None else jnp.zeros_like(h0_all)
+    T, B, I = x.shape
+    H = int(state_size)
+    L = int(num_layers)
+    D = 2 if bidirectional else 1
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+    # slice the flat parameter blob in cuDNN layout: for each layer, for each
+    # direction: i2h_w (G*H, in), h2h_w (G*H, H); then all biases in the same
+    # order (reference rnn-inl.h GetRnnParamSize)
+    def take(offset, shape):
+        size = 1
+        for s in shape:
+            size *= s
+        return w[offset:offset + size].reshape(shape), offset + size
+
+    weights = []
+    off = 0
+    for layer in range(L):
+        inp = I if layer == 0 else H * D
+        per_dir = []
+        for d in range(D):
+            i2h, off = take(off, (ngates * H, inp))
+            h2h, off = take(off, (ngates * H, H))
+            per_dir.append([i2h, h2h, None, None])
+        weights.append(per_dir)
+    for layer in range(L):
+        for d in range(D):
+            i2h_b, off = take(off, (ngates * H,))
+            h2h_b, off = take(off, (ngates * H,))
+            weights[layer][d][2] = i2h_b
+            weights[layer][d][3] = h2h_b
+
+    out = x
+    h_n = []
+    c_n = []
+    for layer in range(L):
+        layer_outs = []
+        for d in range(D):
+            i2h, h2h, i2h_b, h2h_b = weights[layer][d]
+            idx = layer * D + d
+            o, h_t, c_t = rnn_layer_scan(out, i2h, i2h_b, h2h, h2h_b,
+                                         h0_all[idx], c0_all[idx], mode,
+                                         reverse=(d == 1))
+            if mode == "lstm" and lstm_state_clip_min is not None:
+                c_t = jnp.clip(c_t, lstm_state_clip_min, lstm_state_clip_max)
+            layer_outs.append(o)
+            h_n.append(h_t)
+            c_n.append(c_t)
+        out = jnp.concatenate(layer_outs, axis=-1) if D == 2 else layer_outs[0]
+        if p > 0.0 and training and layer != L - 1:
+            from ..random import next_key
+            mask = jax.random.bernoulli(next_key(), 1.0 - p, out.shape)
+            out = jnp.where(mask, out / (1.0 - p), 0.0).astype(out.dtype)
+
+    h_n = jnp.stack(h_n)
+    if mode == "lstm":
+        return out, h_n, jnp.stack(c_n)
+    return out, h_n, jnp.zeros_like(h_n)

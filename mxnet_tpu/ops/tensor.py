@@ -124,10 +124,21 @@ def _smooth_l1(a, scalar=1.0, **_):
 
 # ---------------------------------------------------------------- reductions
 
+def _norm_red_axis(a, axis, exclude):
+    """MXNet reduce semantics: axis may be int/tuple/None; exclude=True means
+    reduce over all axes NOT listed (reference: broadcast_reduce_op.h)."""
+    if exclude:
+        listed = (axis,) if isinstance(axis, int) else tuple(axis or ())
+        listed = tuple(ax % a.ndim for ax in listed)
+        return tuple(i for i in range(a.ndim) if i not in listed)
+    return axis
+
+
 def _red(name, fn, aliases=(), differentiable=True):
     @register(name, aliases=aliases, differentiable=differentiable)
-    def _op(a, axis=None, keepdims=False, _fn=fn, **kw):
-        return _fn(jnp.asarray(a), axis=axis, keepdims=keepdims)
+    def _op(a, axis=None, keepdims=False, exclude=False, _fn=fn, **kw):
+        a = jnp.asarray(a)
+        return _fn(a, axis=_norm_red_axis(a, axis, exclude), keepdims=keepdims)
 
 
 _red("sum", jnp.sum, aliases=("sum_axis",))

@@ -62,7 +62,13 @@ class trace_key_scope:
 
 
 def new_eager_seed_key():
-    """A concrete key derived from global state, for feeding a traced call."""
+    """A concrete key derived from global state, for feeding a traced call.
+
+    Inside an active trace scope this must NOT touch the global key (a split
+    under trace would leak a tracer into global state); it derives from the
+    traced key instead."""
+    if _STATE.trace_stack:
+        return next_key()
     _STATE.key, sub = jax.random.split(_STATE.key)
     return sub
 

@@ -1,0 +1,255 @@
+"""Gluon Block/HybridBlock/Trainer tests.
+
+Modeled on tests/python/unittest/test_gluon.py in the reference.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    x = model(inputs)
+    assert x.shape == (2, 3, 128)
+    assert "test_weight" in model.collect_params()
+
+    model2 = nn.Dense(64, in_units=30, prefix="test2_")
+    model2.initialize()
+    x = model2(mx.nd.zeros((17, 2, 15)))
+    assert x.shape == (17, 64)
+
+
+def test_dense_deferred():
+    model = nn.Dense(8)
+    model.initialize()
+    out = model(mx.nd.zeros((4, 6)))
+    assert out.shape == (4, 8)
+    assert model.weight.shape == (8, 6)
+
+
+def test_sequential_and_hybrid_equivalence():
+    def make():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"))
+            net.add(nn.Dense(4))
+        return net
+
+    net = make()
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(3, 7))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    first = net(x).asnumpy()   # builds cache
+    jit = net(x).asnumpy()     # jit path
+    np.testing.assert_allclose(eager, first, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(eager, jit, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_gradients_match_eager():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(5, activation="tanh"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3))
+
+    def grads():
+        with mx.autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        return {k: v.grad().asnumpy().copy()
+                for k, v in net.collect_params().items()}
+
+    g_eager = grads()
+    net.hybridize()
+    net(x)  # build cache
+    g_jit = grads()
+    for k in g_eager:
+        np.testing.assert_allclose(g_eager[k], g_jit[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.random.normal(loc=2.0, scale=3.0, shape=(8, 4, 2, 2))
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, np.zeros(4)), "running mean should move"
+    # inference mode uses running stats and does not update them
+    rm2_before = bn.running_mean.data().asnumpy().copy()
+    bn(x)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm2_before)
+
+
+def test_conv_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 10, 10))
+    conv = nn.Conv2D(6, 3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 6, 10, 10)
+
+    convt = nn.Conv2DTranspose(4, 2, strides=2)
+    convt.initialize()
+    assert convt(x).shape == (2, 4, 20, 20)
+
+    pool = nn.MaxPool2D(2)
+    assert pool(x).shape == (2, 3, 5, 5)
+
+    gap = nn.GlobalAvgPool2D()
+    assert gap(x).shape == (2, 3, 1, 1)
+
+
+def test_trainer_sgd_converges():
+    # fit y = 2x; the canonical smoke test
+    net = nn.Dense(1, in_units=1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.array(np.random.uniform(-1, 1, (16, 1)))
+    y = x * 2.0
+    for _ in range(100):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+    w = float(net.weight.data().asnumpy().ravel()[0])
+    assert abs(w - 2.0) < 0.1, w
+
+
+def test_trainer_save_load_states():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = mx.nd.random.uniform(shape=(4, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    trainer.save_states("/tmp/test_trainer.states")
+    trainer.load_states("/tmp/test_trainer.states")
+
+
+def test_losses_values():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    expected = -np.log(np.exp([3.0, 3.0])
+                       / np.exp([[1, 2, 3], [3, 2, 1]]).sum(1))
+    np.testing.assert_allclose(l, expected, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, pred + 1).asnumpy()
+    np.testing.assert_allclose(l2, [0.5, 0.5], rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, pred + 2).asnumpy()
+    np.testing.assert_allclose(l1, [2.0, 2.0], rtol=1e-5)
+
+    h = gluon.loss.HuberLoss()(pred, pred + 0.5).asnumpy()
+    np.testing.assert_allclose(h, [0.125, 0.125], rtol=1e-5)
+
+
+def test_block_save_load_parameters():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3))
+    y1 = net(x).asnumpy()
+    net.save_parameters("/tmp/test_block.params")
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters("/tmp/test_block.params")
+    np.testing.assert_allclose(net2(x).asnumpy(), y1, rtol=1e-6)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 5)
+    layer.initialize()
+    idx = mx.nd.array([0, 1, 9])
+    out = layer(idx)
+    assert out.shape == (3, 5)
+    with mx.autograd.record():
+        loss = layer(idx).sum()
+    loss.backward()
+    assert layer.weight.grad().shape == (10, 5)
+
+
+def test_layernorm_groupnorm():
+    x = mx.nd.random.uniform(shape=(2, 8, 4))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    out = ln(x).asnumpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros((2, 8)), atol=1e-5)
+
+    x4 = mx.nd.random.uniform(shape=(2, 8, 3, 3))
+    gn = nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    assert gn(x4).shape == (2, 8, 3, 3)
+
+
+def test_activations_layers():
+    x = mx.nd.array([[-1.0, 0.0, 1.0]])
+    for Act, check in [
+        (nn.LeakyReLU(0.1), [-0.1, 0.0, 1.0]),
+        (nn.ELU(1.0), [np.exp(-1) - 1, 0.0, 1.0]),
+    ]:
+        out = Act(x).asnumpy().ravel()
+        np.testing.assert_allclose(out, check, rtol=1e-4, atol=1e-6)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    np.testing.assert_allclose(prelu(x).asnumpy().ravel(), [-0.25, 0, 1],
+                               rtol=1e-5)
+
+
+def test_lambda_blocks():
+    double = nn.Lambda(lambda x: x * 2)
+    np.testing.assert_allclose(double(mx.nd.ones((2,))).asnumpy(), [2, 2])
+    hl = nn.HybridLambda(lambda F, x: F.relu(x))
+    np.testing.assert_allclose(hl(mx.nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
+
+
+def test_zero_grad_and_grad_req():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = mx.nd.ones((1, 2))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    assert net.weight.grad().asnumpy().any()
+    net.collect_params().zero_grad()
+    assert not net.weight.grad().asnumpy().any()
+    net.weight.grad_req = "null"
+    assert net.weight._grad is None

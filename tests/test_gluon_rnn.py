@@ -1,0 +1,113 @@
+"""RNN layer/cell tests (modeled on tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_layers_shapes():
+    for layer, state_mult in [(rnn.RNN(8, 2), 1), (rnn.GRU(8, 2), 1),
+                              (rnn.LSTM(8, 2), 2)]:
+        layer.initialize()
+        x = mx.nd.random.uniform(shape=(5, 3, 4))  # TNC
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        out, states = layer(x, layer.begin_state(batch_size=3))
+        assert out.shape == (5, 3, 8)
+        assert len(states) == state_mult
+        for s in states:
+            assert s.shape == (2, 3, 8)
+
+
+def test_rnn_bidirectional_ntc():
+    layer = rnn.LSTM(6, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5, 4))
+    out = layer(x)
+    assert out.shape == (3, 5, 12)
+
+
+def test_lstm_cell_matches_fused():
+    """One-layer unidirectional fused LSTM == LSTMCell unroll."""
+    hidden = 5
+    layer = rnn.LSTM(hidden, num_layers=1, input_size=4)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(7, 2, 4))
+
+    cell = rnn.LSTMCell(hidden, input_size=4)
+    # share parameters: copy fused weights into cell
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+
+    fused_out = layer(x).asnumpy()
+    cell_out, _ = cell.unroll(7, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused_out, cell_out.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_cell_matches_fused():
+    hidden = 5
+    layer = rnn.GRU(hidden, num_layers=1, input_size=4)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(6, 2, 4))
+    cell = rnn.GRUCell(hidden, input_size=4)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    fused_out = layer(x).asnumpy()
+    cell_out, _ = cell.unroll(6, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused_out, cell_out.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rnn_gradient_flows():
+    layer = rnn.LSTM(4, num_layers=2)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(5, 2, 3))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    for name, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all(), name
+        assert np.abs(g).sum() > 0, name
+
+
+def test_sequential_rnn_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.LSTMCell(5, input_size=4))
+    stack.initialize()
+    x = mx.nd.random.uniform(shape=(2, 6, 3))
+    outputs, states = stack.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 6, 5)
+    assert len(states) == 4
+
+
+def test_bidirectional_cell_unroll():
+    cell = rnn.BidirectionalCell(rnn.GRUCell(4, input_size=3),
+                                 rnn.GRUCell(4, input_size=3))
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 3))
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+
+
+def test_residual_zoneout_dropout_cells():
+    base = rnn.GRUCell(3, input_size=3)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4, 3))
+    outputs, _ = res.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 4, 3)
+
+    drop = rnn.DropoutCell(0.3)
+    outputs, _ = drop.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 4, 3)

@@ -1,0 +1,164 @@
+"""Optimizer tests (modeled on tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.ndarray import ndarray as nd_mod
+
+
+ALL_OPTIMIZERS = ["sgd", "nag", "signum", "signsgd", "ftml", "lars", "lbsgd",
+                  "dcasgd", "sgld", "adam", "adagrad", "rmsprop", "adadelta",
+                  "ftrl", "adamax", "nadam", "groupadagrad", "test"]
+
+
+@pytest.mark.parametrize("name", ALL_OPTIMIZERS)
+def test_optimizer_step_runs(name):
+    o = opt.create(name, learning_rate=0.01)
+    w = nd_mod.array(np.random.uniform(-1, 1, (4, 3)).astype("float32"))
+    g = nd_mod.array(np.random.uniform(-1, 1, (4, 3)).astype("float32"))
+    state = o.create_state(0, w)
+    before = w.asnumpy().copy()
+    o.update(0, w, g, state)
+    after = w.asnumpy()
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)
+
+
+def test_sgd_momentum_math():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.0, rescale_grad=1.0)
+    w = nd_mod.array(np.ones((2, 2), dtype="float32"))
+    g = nd_mod.array(np.full((2, 2), 0.5, dtype="float32"))
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # mom = 0.9*0 + 0.1*0.5 = 0.05; w = 1 - 0.05
+    np.testing.assert_allclose(w.asnumpy(), np.full((2, 2), 0.95), rtol=1e-6)
+    o.update(0, w, g, state)
+    # mom = 0.9*0.05 + 0.05 = 0.095
+    np.testing.assert_allclose(w.asnumpy(), np.full((2, 2), 0.95 - 0.095),
+                               rtol=1e-6)
+
+
+def test_adam_math():
+    o = opt.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    w = nd_mod.array(np.ones((3,), dtype="float32"))
+    g = nd_mod.array(np.full((3,), 0.2, dtype="float32"))
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    m = 0.1 * 0.2
+    v = 0.001 * 0.04
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expected = 1 - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), np.full((3,), expected), rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler, \
+        PolyScheduler, CosineScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+    m = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-9
+    assert abs(m(11) - 0.01) < 1e-9
+
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert p(100) == 0.0
+
+    c = CosineScheduler(max_update=100, base_lr=1.0)
+    assert c(0) == 1.0
+    assert abs(c(100)) < 1e-9
+
+
+def test_warmup():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    s = FactorScheduler(step=1000, factor=1.0, base_lr=1.0, warmup_steps=10,
+                        warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.5) < 1e-9
+    assert s(10) == 1.0
+
+
+def test_multi_precision_sgd():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w16 = nd_mod.array(np.ones((2, 2)), dtype="float16")
+    g16 = nd_mod.array(np.full((2, 2), 0.5), dtype="float16")
+    state = o.create_state_multi_precision(0, w16)
+    master, _ = state
+    assert str(master.dtype) == "float32"
+    o.update_multi_precision(0, w16, g16, state)
+    assert str(w16.dtype) == "float16"
+    np.testing.assert_allclose(w16.asnumpy().astype("float32"),
+                               np.full((2, 2), 0.95), rtol=1e-3)
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam()
+    u = opt.get_updater(o)
+    w = nd_mod.array(np.ones((2,), dtype="float32"))
+    g = nd_mod.array(np.ones((2,), dtype="float32"))
+    u(0, g, w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam())
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_metrics():
+    from mxnet_tpu import metric
+    acc = metric.Accuracy()
+    pred = nd_mod.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd_mod.array([1, 0, 0])
+    acc.update([label], [pred])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+
+    topk = metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == 1.0
+
+    mse = metric.MSE()
+    mse.update([nd_mod.array([1.0, 2.0])], [nd_mod.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    expected = -np.mean(np.log([0.7, 0.9, 0.4]))
+    assert abs(ce.get()[1] - expected) < 1e-5
+
+    comp = metric.create(["acc", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+
+    perp = metric.Perplexity(ignore_label=None)
+    perp.update([label], [pred])
+    assert perp.get()[1] > 1.0
+
+
+def test_initializers():
+    from mxnet_tpu import init
+    import jax
+    key = jax.random.PRNGKey(0)
+    for i, check in [
+        (init.Zero(), lambda a: np.allclose(a, 0)),
+        (init.One(), lambda a: np.allclose(a, 1)),
+        (init.Constant(3.0), lambda a: np.allclose(a, 3)),
+        (init.Uniform(0.5), lambda a: np.abs(a).max() <= 0.5),
+        (init.Normal(0.1), lambda a: np.abs(a).mean() < 0.5),
+        (init.Xavier(), lambda a: np.isfinite(a).all()),
+        (init.MSRAPrelu(), lambda a: np.isfinite(a).all()),
+    ]:
+        val = np.asarray(i.generate(key, (8, 8), "float32", name="w_weight"))
+        assert check(val), type(i).__name__
+
+    ortho = np.asarray(init.Orthogonal().generate(key, (4, 4), "float32",
+                                                  name="w_weight"))
+    s = np.linalg.svd(ortho / 1.414)[1]
+    np.testing.assert_allclose(s, np.ones(4), rtol=1e-4)
+
+    # name-suffix dispatch
+    gamma = np.asarray(init.Xavier().generate(key, (4,), "float32",
+                                              name="bn_gamma"))
+    np.testing.assert_allclose(gamma, np.ones(4))
