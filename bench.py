@@ -1,0 +1,63 @@
+"""Headline benchmark: ResNet-50 training throughput (synthetic data).
+
+Mirrors the reference harness `example/image-classification/train_imagenet.py
+--benchmark 1` (synthetic-data training throughput); baseline is the
+reference's published 363.69 img/s fp32 @BS128 on 1xV100
+(docs/static_site/src/pages/api/faq/perf.md:254, see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69  # ResNet-50 fp32 train, 1xV100, BS128
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    batch = 128
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize(mx.init.Xavier())
+
+    mesh = make_mesh({"dp": -1})  # 1 chip under the driver; dp-scales as-is
+    trainer = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9,
+                           "wd": 1e-4},
+                          mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(size=(batch, 3, 224, 224)).astype(np.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(np.float32)
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = trainer.step(data, label)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data, label)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -193,6 +193,8 @@ class Parameter:
             val = data._data
         else:
             val = jnp.asarray(data)
+        if isinstance(ctx_list, Context):
+            ctx_list = [ctx_list]
         self._ctx_list = list(ctx_list) if ctx_list else [current_context()]
         self._data = _wrap(jnp.asarray(val, dtype_np(self.dtype)))
         self._init_grad()

@@ -1,0 +1,10 @@
+"""mxnet_tpu.models — flagship SPMD model definitions.
+
+Gluon-style model zoo lives in mxnet_tpu.gluon.model_zoo (reference parity:
+python/mxnet/gluon/model_zoo/vision/); this package holds the pure-functional
+mesh-aware flagships used for scale benchmarks (transformer LM with
+dp/tp/sp sharding).
+"""
+from .transformer import TransformerLM, TransformerLMConfig
+
+__all__ = ["TransformerLM", "TransformerLMConfig"]

@@ -1,0 +1,181 @@
+"""TransformerLM — flagship SPMD language model (pure-functional).
+
+The reference's largest-scale story is data-parallel ResNet/LSTM via KVStore
+(SURVEY.md §2.3); it predates tensor/sequence parallelism.  A TPU-native
+framework must treat those as first-class, so this model is written directly
+against the mesh axes of mxnet_tpu.parallel.mesh:
+
+  - batch            -> 'dp'
+  - attention heads / MLP hidden -> 'tp'   (Megatron-style column/row splits)
+  - sequence         -> 'sp'   (ring attention, parallel/ring_attention.py)
+  - layers are stacked and scanned (lax.scan) — the stacking dimension is the
+    natural pipeline ('pp') axis for later stages.
+
+Everything is a dict pytree of jax arrays + a dict of PartitionSpecs; the
+fused train step (parallel/trainer.py) or any jax transform composes with it.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring_attention import attention, ring_self_attention_sharded
+
+__all__ = ["TransformerLMConfig", "TransformerLM"]
+
+
+class TransformerLMConfig:
+    def __init__(self, vocab_size=32000, num_layers=12, d_model=768,
+                 num_heads=12, d_ff=3072, max_len=2048,
+                 dtype=jnp.bfloat16, causal=True):
+        assert d_model % num_heads == 0
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.head_dim = d_model // num_heads
+        self.d_ff = d_ff
+        self.max_len = max_len
+        self.dtype = dtype
+        self.causal = causal
+
+
+def _norm(x, scale, eps=1e-6):
+    # RMSNorm in fp32 for stability, output in model dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+class TransformerLM:
+    """Decoder-only transformer; params stacked over layers and scanned."""
+
+    def __init__(self, config, mesh=None):
+        self.cfg = config
+        self.mesh = mesh
+        names = mesh.axis_names if mesh is not None else ()
+        self._dp = "dp" if "dp" in names else None
+        self._tp = "tp" if "tp" in names else None
+        self._sp = "sp" if "sp" in names else None
+
+    # -------------------------------------------------------------- params
+    def init(self, key):
+        cfg = self.cfg
+        k = jax.random.split(key, 8)
+        D, F, V, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.num_layers
+        H, Dh = cfg.num_heads, cfg.head_dim
+        init = jax.nn.initializers.normal(0.02)
+
+        def mk(kk, shape, fan_in=None):
+            w = init(kk, shape, jnp.float32)
+            if fan_in:
+                w = w / math.sqrt(fan_in / D)
+            return w.astype(cfg.dtype)
+
+        params = {
+            "embed": mk(k[0], (V, D)),
+            "pos_embed": mk(k[1], (cfg.max_len, D)),
+            "final_norm": jnp.ones((D,), cfg.dtype),
+            "layers": {
+                "ln1": jnp.ones((L, D), cfg.dtype),
+                "wqkv": mk(k[2], (L, D, 3, H, Dh)),
+                "wo": mk(k[3], (L, H, Dh, D)),
+                "ln2": jnp.ones((L, D), cfg.dtype),
+                "w1": mk(k[4], (L, D, F)),
+                "w2": mk(k[5], (L, F, D)),
+            },
+        }
+        return params
+
+    def param_specs(self):
+        """PartitionSpec per param — Megatron column/row splits on 'tp'."""
+        tp = self._tp
+        return {
+            "embed": P(None, None),
+            "pos_embed": P(None, None),
+            "final_norm": P(None),
+            "layers": {
+                "ln1": P(None, None),
+                "wqkv": P(None, None, None, tp, None),
+                "wo": P(None, tp, None, None),
+                "ln2": P(None, None),
+                "w1": P(None, None, tp),
+                "w2": P(None, tp, None),
+            },
+        }
+
+    # -------------------------------------------------------------- forward
+    def _constrain(self, x, *spec):
+        if self.mesh is None:
+            return x
+        return lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, P(*spec)))
+
+    def _attention(self, q, k, v):
+        # q,k,v: [B, H, S, Dh]
+        if self.mesh is not None and self._sp is not None and \
+                self.mesh.shape.get(self._sp, 1) > 1:
+            return ring_self_attention_sharded(
+                self.mesh, q, k, v, causal=self.cfg.causal,
+                batch_axis=self._dp, head_axis=self._tp, seq_axis=self._sp)
+        return attention(q, k, v, causal=self.cfg.causal)
+
+    def _layer(self, x, lp):
+        cfg = self.cfg
+        B, S, D = x.shape
+        H, Dh = cfg.num_heads, cfg.head_dim
+
+        h = _norm(x, lp["ln1"])
+        qkv = jnp.einsum("bsd,dche->bsche", h, lp["wqkv"],
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        q = jnp.transpose(qkv[:, :, 0], (0, 2, 1, 3))   # [B,H,S,Dh]
+        k = jnp.transpose(qkv[:, :, 1], (0, 2, 1, 3))
+        v = jnp.transpose(qkv[:, :, 2], (0, 2, 1, 3))
+        q = self._constrain(q, self._dp, self._tp, self._sp, None)
+        k = self._constrain(k, self._dp, self._tp, self._sp, None)
+        v = self._constrain(v, self._dp, self._tp, self._sp, None)
+        o = self._attention(q, k, v)                    # [B,H,S,Dh]
+        o = jnp.einsum("bhse,hed->bsd", o, lp["wo"],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + o
+        x = self._constrain(x, self._dp, self._sp, None)
+
+        h = _norm(x, lp["ln2"])
+        u = jnp.einsum("bsd,df->bsf", h, lp["w1"],
+                       preferred_element_type=jnp.float32)
+        u = jax.nn.gelu(u).astype(x.dtype)
+        u = self._constrain(u, self._dp, self._sp, self._tp)
+        d = jnp.einsum("bsf,fd->bsd", u, lp["w2"],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + d
+        return self._constrain(x, self._dp, self._sp, None)
+
+    def apply(self, params, tokens):
+        """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
+        cfg = self.cfg
+        S = tokens.shape[1]
+        x = params["embed"][tokens] + params["pos_embed"][:S][None]
+        x = x.astype(cfg.dtype)
+        x = self._constrain(x, self._dp, self._sp, None)
+
+        def body(carry, lp):
+            return self._layer(carry, lp), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = _norm(x, params["final_norm"])
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"],
+                            preferred_element_type=jnp.float32)
+        return logits
+
+    def loss(self, params, tokens, targets):
+        """Mean next-token cross entropy; targets [B, S] int32."""
+        logits = self.apply(params, tokens)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return jnp.mean(logz - gold)

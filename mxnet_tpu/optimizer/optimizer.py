@@ -491,7 +491,8 @@ class SGLD(Optimizer):
         import jax
         g = grad + wd * weight
         noise = jax.random.normal(_random.new_eager_seed_key(), weight.shape,
-                                  weight.dtype) * math.sqrt(lr)
+                                  weight.dtype) * jnp.sqrt(
+                                      jnp.asarray(lr, weight.dtype))
         return weight - lr / 2 * g + noise, None
 
 
@@ -524,9 +525,10 @@ class Adam(Optimizer):
     def step(self, weight, grad, state, lr, wd, t):
         m, v = state
         g = grad + wd * weight
+        # t may be a traced array inside a jitted train step — jnp math only
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        lr_t = lr * jnp.sqrt(coef2) / coef1
         m = self.beta1 * m + (1.0 - self.beta1) * g
         v = self.beta2 * v + (1.0 - self.beta2) * g * g
         w = weight - lr_t * m / (jnp.sqrt(v) + self.epsilon)

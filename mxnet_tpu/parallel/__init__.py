@@ -1,0 +1,57 @@
+"""mxnet_tpu.parallel — SPMD scaling layer (mesh, collectives, ring
+attention, fused train step).
+
+This package is the TPU-native replacement for the reference's entire
+communication stack (SURVEY.md §5.8): KVStore local/device comm
+(src/kvstore/comm.h), NCCL backend (src/kvstore/kvstore_nccl.h), and the
+ps-lite parameter server (src/kvstore/kvstore_dist.h) all collapse into XLA
+collectives over a named Mesh; ``jax.distributed.initialize`` replaces the
+ps-lite scheduler rendezvous.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import (AXES, make_mesh, data_parallel_mesh, sharding,
+                   shard_batch, replicated, Mesh, NamedSharding,
+                   PartitionSpec)
+from .ring_attention import ring_attention, attention, \
+    ring_self_attention_sharded
+from .functional import functionalize, BlockFunction
+from .trainer import SPMDTrainer, build_train_step
+
+__all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
+           "shard_batch", "replicated", "Mesh", "NamedSharding",
+           "PartitionSpec", "ring_attention", "attention",
+           "ring_self_attention_sharded", "functionalize", "BlockFunction",
+           "SPMDTrainer", "build_train_step", "host_allreduce",
+           "initialize", "barrier"]
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host rendezvous — the ps-lite scheduler analog
+    (DMLC_PS_ROOT_URI env rendezvous, src/kvstore/kvstore_dist.h).  Reads
+    standard cluster env when args are None."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def host_allreduce(val):
+    """Sum a host-local array across all processes (DCN allreduce) — the
+    dist_sync server-merge analog (src/kvstore/kvstore_dist_server.h:349)."""
+    if jax.process_count() == 1:
+        return val
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jnp.asarray(val))
+    return jnp.sum(gathered, axis=0)
+
+
+def barrier(name="kvstore"):
+    """Global barrier (reference: KVStore::Barrier,
+    include/mxnet/kvstore.h:300)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

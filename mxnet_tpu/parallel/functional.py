@@ -1,0 +1,91 @@
+"""Functionalize a Gluon Block into a pure (params, apply) pair.
+
+Reference analog: CachedOp extracts a static NNVM graph from a HybridBlock
+(src/imperative/cached_op.cc; python/mxnet/gluon/block.py:969 _build_cache)
+so the executor can schedule it without Python.  On TPU the equivalent is a
+*pure function* over a parameter pytree: XLA compiles it once, and every
+sharding/parallelism decision (pjit/shard_map) composes with it.
+
+``functionalize(block)`` returns the trainable/aux split plus an ``apply``
+suitable for jax.grad / jax.jit / pjit: auxiliary-state mutations (BatchNorm
+running stats — grad_req='null' parameters written during forward) are
+captured during tracing and returned explicitly, keeping ``apply`` pure.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..ndarray.ndarray import _wrap
+from .. import autograd
+from .. import _tape  # noqa: F401  (kept: recording must be off inside apply)
+from .. import random as _random
+
+__all__ = ["functionalize", "BlockFunction"]
+
+
+class BlockFunction:
+    """Pure-function view of a Block.
+
+    Attributes:
+      params        OrderedDict name -> Parameter (all of them)
+      trainable     list of names with grad_req != 'null'
+      aux           list of names with grad_req == 'null' (running stats)
+    ``apply(param_map, inputs, key, training)`` takes/returns raw jax arrays:
+      -> (outputs_tuple, new_aux_map)
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self.params = OrderedDict(
+            (name, p) for name, p in block.collect_params().items())
+        self.trainable = [n for n, p in self.params.items()
+                          if p.grad_req != "null"]
+        self.aux = [n for n, p in self.params.items() if p.grad_req == "null"]
+
+    def init_values(self):
+        """Current parameter values as {name: jax.Array}."""
+        return {n: p.data()._data for n, p in self.params.items()}
+
+    def apply(self, param_map, inputs, key=None, training=True):
+        from ..gluon import block as block_mod
+        block = self.block
+        params = self.params
+        if key is None:
+            key = _random.new_eager_seed_key()
+        originals = {}
+        wrappers = {}
+        for n, p in params.items():
+            originals[n] = p._data
+            w = _wrap(param_map[n])
+            wrappers[n] = w
+            p._data = w
+        prev_guard = block_mod._TRACE_GUARD.active
+        block_mod._TRACE_GUARD.active = True
+        try:
+            with autograd._RecordingStateScope(False, training):
+                with _random.trace_key_scope(key):
+                    out = block._eager_forward(
+                        *[_wrap(v) for v in inputs])
+        finally:
+            block_mod._TRACE_GUARD.active = prev_guard
+            for n, p in params.items():
+                p._data = originals[n]
+        multi = isinstance(out, (tuple, list))
+        out_vals = tuple(o._data for o in out) if multi else (out._data,)
+        new_aux = {}
+        for n in self.aux:
+            w = wrappers[n]
+            if w._data is not param_map[n]:
+                new_aux[n] = w._data
+        return out_vals, new_aux
+
+    def write_back(self, param_map):
+        """Write jax values back into the live Parameters (post-training)."""
+        for n, p in self.params.items():
+            if n in param_map:
+                with autograd.pause():
+                    p.data()._data = param_map[n]
+
+
+def functionalize(block):
+    return BlockFunction(block)

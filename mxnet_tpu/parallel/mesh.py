@@ -1,0 +1,90 @@
+"""Device-mesh construction and sharding-axis conventions.
+
+Replaces the reference's device-topology machinery — KVStore comm topologies
+solved from the PCIe/NVLink link matrix (src/kvstore/gpu_topology.h,
+src/kvstore/comm_tree.h:50) and manual ``group2ctx`` placement
+(src/executor/graph_executor.cc:997).  On TPU the topology is a named
+``jax.sharding.Mesh`` and placement is a PartitionSpec; XLA lowers every
+cross-device exchange to ICI/DCN collectives.
+
+Axis conventions (used across mxnet_tpu.parallel and mxnet_tpu.models):
+  'dp'  data parallel          (batch dimension)
+  'fsdp' fully-sharded DP      (parameters sharded over the dp workers)
+  'tp'  tensor parallel        (attention heads / hidden features)
+  'sp'  sequence/context par.  (ring attention over sequence blocks)
+  'pp'  pipeline parallel      (layer stages)
+  'ep'  expert parallel        (MoE experts)
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
+           "shard_batch", "replicated", "local_mesh_devices",
+           "PartitionSpec", "Mesh", "NamedSharding"]
+
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+def local_mesh_devices(n=None):
+    devs = jax.devices()
+    if n is not None:
+        if len(devs) < n:
+            raise ValueError(
+                "Requested %d devices but only %d available" % (n, len(devs)))
+        devs = devs[:n]
+    return devs
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a Mesh from an {axis_name: size} dict.
+
+    Sizes may use -1 for one axis to absorb the remaining devices, mirroring
+    how the reference auto-solves its reduction topology from whatever links
+    exist (gpu_topology.h) — here the "solver" is trivial because the TPU
+    torus is homogeneous and XLA handles the physical routing.
+    """
+    if axes is None:
+        axes = {"dp": -1}
+    devices = list(devices) if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = [int(axes[n]) for n in names]
+    n_dev = len(devices)
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n_dev % known:
+            raise ValueError("Cannot infer -1 axis: %d devices, known=%d"
+                             % (n_dev, known))
+        sizes[sizes.index(-1)] = n_dev // known
+    if math.prod(sizes) != n_dev:
+        raise ValueError("Mesh %s does not cover %d devices"
+                         % (dict(zip(names, sizes)), n_dev))
+    arr = _np.asarray(devices, dtype=object).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None):
+    return make_mesh({"dp": -1}, devices)
+
+
+def sharding(mesh, *spec):
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_batch(mesh, batch, axis="dp"):
+    """Place an array (or pytree) with dim-0 sharded over `axis` —
+    the DataParallelExecutorGroup slice-over-contexts analog
+    (python/mxnet/module/executor_group.py:144), done by sharding instead
+    of slicing."""
+    sh = sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sh), batch)
+
+
+def replicated(mesh, tree):
+    sh = sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)

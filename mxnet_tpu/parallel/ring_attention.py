@@ -1,0 +1,111 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has no sequence parallelism (SURVEY.md §5.7: long sequences are
+handled by BucketingModule bucketing, python/mxnet/module/bucketing_module.py:40);
+for a TPU-native framework long-context is first-class, so attention shards
+its sequence dimension over the 'sp' mesh axis and rotates key/value blocks
+around the ring with ``lax.ppermute`` while accumulating a numerically-stable
+online softmax (flash-attention style running max / running sum).  Each hop
+rides one ICI link, so per-step comm is O(block) and overlaps the matmuls.
+
+Layouts (global logical shapes):
+  q, k, v: [batch, heads, seq, head_dim]
+  sharding: batch -> 'dp', heads -> 'tp', seq -> 'sp'
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+__all__ = ["ring_attention", "attention", "ring_self_attention_sharded"]
+
+_NEG = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One q-block x kv-block partial attention.
+
+    Returns (o_partial, m, l): un-normalized output, row max, row sum.
+    q: [..., Sq, D], k/v: [..., Sk, D], mask broadcastable to [..., Sq, Sk].
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.einsum("...qk,...kd->...qd", e.astype(v.dtype), v)
+    return o, m, l
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Single-device (or XLA-sharded) softmax attention; fp32 accumulate on
+    the MXU via preferred_element_type."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    mask = None
+    if causal:
+        sq, sk = q.shape[-2], k.shape[-2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    o, m, l = _block_attn(q, k, v, scale, mask)
+    return (o / l.astype(o.dtype)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Ring attention over `axis_name`: call INSIDE shard_map.
+
+    q/k/v are the local sequence shards [B, H, S_loc, D].  Equivalent math to
+    full attention over the gathered sequence, at O(S_loc) memory.
+    """
+    d = q.shape[-1]
+    s_loc = q.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc_shape = q.shape[:-1] + (d,)
+    o0 = jnp.zeros(acc_shape, jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), _NEG, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+
+    def body(step, carry):
+        o, m, l, kb, vb = carry
+        src = (my - step) % n
+        mask = None
+        if causal:
+            q_pos = my * s_loc + jnp.arange(s_loc)[:, None]
+            k_pos = src * s_loc + jnp.arange(s_loc)[None, :]
+            mask = k_pos <= q_pos
+        ob, mb, lb = _block_attn(q, kb, vb, scale, mask)
+        m_new = jnp.maximum(m, mb)
+        corr = jnp.exp(m - m_new)
+        corr_b = jnp.exp(mb - m_new)
+        o = o * corr + ob.astype(jnp.float32) * corr_b
+        l = l * corr + lb * corr_b
+        # rotate kv one hop around the ring (ICI neighbor exchange)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, m_new, l, kb, vb
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_self_attention_sharded(mesh, q, k, v, causal=False,
+                                batch_axis="dp", head_axis="tp",
+                                seq_axis="sp"):
+    """shard_map-wrapped ring attention over a full [B, H, S, D] array whose
+    axes are sharded (batch->'dp', heads->'tp', seq->'sp') on `mesh`."""
+    spec = P(batch_axis, head_axis, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    shmapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)
+    return shmapped(q, k, v)

@@ -1,0 +1,229 @@
+"""SPMD training step — the TPU-native replacement for the reference's
+Module.fit hot loop + KVStore gradient sync.
+
+Reference path (SURVEY.md §3.3-3.4): per batch, DataParallelExecutorGroup
+slices data over contexts (python/mxnet/module/executor_group.py:144), the
+GraphExecutor pushes bulked engine ops (src/executor/graph_executor.cc:1384),
+then KVStore reduces gradients across devices (src/kvstore/comm.h:451) and an
+Updater applies the optimizer.  Four subsystems, all asynchrony hand-managed.
+
+Here the ENTIRE iteration — forward, backward, gradient allreduce, optimizer
+update — is ONE jitted function over a named mesh.  Batch dims are sharded on
+'dp', parameters replicated (or sharded for tensor-parallel models), and XLA
+inserts the psum/all-gather collectives and overlaps them with compute; the
+engine/kvstore/bulking machinery has no residual role on the hot path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .functional import functionalize
+from .mesh import data_parallel_mesh
+
+__all__ = ["SPMDTrainer", "build_train_step"]
+
+
+def _opt_hyper(optimizer, index):
+    lr = optimizer._get_lr(index)
+    wd = optimizer._get_wd(index)
+    return lr, wd
+
+
+class SPMDTrainer:
+    """Fused-step trainer for a Gluon block on a device mesh.
+
+    Usage::
+
+        trainer = SPMDTrainer(net, loss_fn, 'sgd',
+                              {'learning_rate': 0.1, 'momentum': 0.9},
+                              mesh=mesh)
+        for data, label in loader:
+            loss = trainer.step(data, label)
+        trainer.sync()           # write weights back into the Block
+
+    loss_fn(pred, label) must return a per-example or scalar loss NDArray-free
+    (it is called on raw jax arrays via the functionalized block — gluon.loss
+    objects work because they are HybridBlocks; plain callables on jnp arrays
+    work too).
+    """
+
+    def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
+                 mesh=None, batch_axis="dp", param_specs=None,
+                 donate=True):
+        from .. import optimizer as opt_mod
+        self.fn = functionalize(block)
+        self.block = block
+        self.loss_fn = loss_fn
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self.batch_axis = batch_axis if batch_axis in self.mesh.axis_names \
+            else self.mesh.axis_names[0]
+        self._param_specs = param_specs or {}
+
+        self.params = None
+        self.opt_state = None
+        self._step_num = 0
+        self._jitted = None
+        self._donate = donate
+
+    def _materialize(self, data):
+        """Snapshot the Block's parameters into device-placed jax arrays.
+
+        Deferred-shape parameters (Gluon semantics: shape inference happens on
+        the first forward, python/mxnet/gluon/block.py:979-1036) are resolved
+        by one eager forward on the first batch.  Values are COPIED: the
+        jitted step donates its inputs, and donating buffers still referenced
+        by the live Parameters would delete them under the Block.
+        """
+        from ..gluon.parameter import DeferredInitializationError
+        from ..ndarray.ndarray import _wrap
+        try:
+            vals = self.fn.init_values()
+        except DeferredInitializationError:
+            self.block(_wrap(jnp.asarray(data)))
+            self.fn = functionalize(self.block)
+            vals = self.fn.init_values()
+        self.params = {n: jnp.array(v) for n, v in vals.items()}
+        self.opt_state = {}
+        for i, name in enumerate(self.fn.trainable):
+            st = self.optimizer.create_state(i, _wrap(self.params[name]))
+            self.opt_state[name] = _state_to_jax(st)
+        self._place()
+
+    # ------------------------------------------------------------ placement
+    def _spec_for(self, name):
+        return self._param_specs.get(name, P())  # default: replicated
+
+    def _place(self):
+        mesh = self.mesh
+        for n in list(self.params.keys()):
+            sh = NamedSharding(mesh, self._spec_for(n))
+            self.params[n] = jax.device_put(self.params[n], sh)
+            if n in self.opt_state and self.opt_state[n] is not None:
+                self.opt_state[n] = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sh), self.opt_state[n])
+
+    # ------------------------------------------------------------ step build
+    def _build(self):
+        fn = self.fn
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        trainable = fn.trainable
+        mesh = self.mesh
+        batch_sh = NamedSharding(mesh, P(self.batch_axis))
+        param_sh = {n: NamedSharding(mesh, self._spec_for(n))
+                    for n in fn.params}
+
+        def loss_of(train_params, aux_params, data, label, key):
+            param_map = dict(aux_params)
+            param_map.update(train_params)
+            (out,), new_aux = fn.apply(param_map, (data,), key, training=True)
+            loss = _as_scalar_loss(loss_fn, out, label)
+            return loss, (new_aux, out)
+
+        def step(train_params, aux_params, opt_state, data, label, key, t,
+                 lr_scale):
+            (loss, (new_aux, _)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_params, aux_params, data, label,
+                                       key)
+            new_params = {}
+            new_state = {}
+            from .. import random as _random
+            # Stochastic optimizers (SGLD noise) must draw from the step's
+            # traced key, not bake a trace-time constant into the compiled
+            # program — keep a trace key scope open for the update loop.
+            with _random.trace_key_scope(jax.random.fold_in(key, 1)):
+                for i, n in enumerate(trainable):
+                    lr, wd = _opt_hyper(optimizer, i)
+                    w, s = optimizer.step(train_params[n],
+                                          _preprocess(optimizer, grads[n]),
+                                          opt_state[n], lr * lr_scale, wd, t)
+                    new_params[n] = w.astype(train_params[n].dtype)
+                    new_state[n] = s
+            aux_out = dict(aux_params)
+            aux_out.update(new_aux)
+            return new_params, aux_out, new_state, loss
+
+        # Sharding is carried by the arguments themselves (params were
+        # device_put with their NamedShardings in _place(); the batch is
+        # sharded in step()): XLA propagates and inserts the gradient
+        # allreduce — the entire KVStore push/pull of the reference
+        # (src/kvstore/comm.h:451) becomes one compiler-scheduled psum.
+        self._batch_sharding = batch_sh
+        del param_sh
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------ public
+    def step(self, data, label, lr_scale=1.0):
+        """Run one fused train step; returns the (device-resident) loss."""
+        from ..ndarray.ndarray import NDArray
+        if isinstance(data, NDArray):
+            data = data._data
+        if isinstance(label, NDArray):
+            label = label._data
+        if self.params is None:
+            self._materialize(data)
+        if self._jitted is None:
+            self._jitted = self._build()
+        data = jax.device_put(jnp.asarray(data), self._batch_sharding)
+        label = jax.device_put(jnp.asarray(label), self._batch_sharding)
+        self._step_num += 1
+        self.optimizer.num_update = self._step_num
+        from .. import random as _random
+        key = _random.new_eager_seed_key()
+        train = {n: self.params[n] for n in self.fn.trainable}
+        aux = {n: self.params[n] for n in self.fn.aux}
+        new_train, new_aux, self.opt_state, loss = self._jitted(
+            train, aux, self.opt_state, data, label, key,
+            jnp.asarray(self._step_num, jnp.int32),
+            jnp.asarray(lr_scale, jnp.float32))
+        self.params = {}
+        self.params.update(new_train)
+        self.params.update(new_aux)
+        return loss
+
+    def sync(self):
+        """Write device params back into the Block's Parameters."""
+        self.fn.write_back(self.params)
+
+
+def _state_to_jax(st):
+    from ..ndarray.ndarray import NDArray
+    if st is None:
+        return None
+    if isinstance(st, NDArray):
+        return st._data
+    if isinstance(st, (tuple, list)):
+        return tuple(_state_to_jax(s) for s in st)
+    return st
+
+
+def _preprocess(optimizer, grad):
+    g = grad * optimizer.rescale_grad
+    if optimizer.clip_gradient is not None:
+        g = jnp.clip(g, -optimizer.clip_gradient, optimizer.clip_gradient)
+    return g
+
+
+def _as_scalar_loss(loss_fn, out, label):
+    from ..ndarray.ndarray import NDArray, _wrap
+    try:
+        loss = loss_fn(_wrap(out), _wrap(label))
+        loss = loss._data if isinstance(loss, NDArray) else loss
+    except (TypeError, AttributeError):
+        loss = loss_fn(out, label)
+        loss = loss._data if isinstance(loss, NDArray) else loss
+    return jnp.mean(loss.astype(jnp.float32))
+
+
+def build_train_step(block, loss_fn, optimizer, optimizer_params=None,
+                     mesh=None, **kw):
+    """Convenience: construct an SPMDTrainer and return (trainer, step_fn)."""
+    tr = SPMDTrainer(block, loss_fn, optimizer, optimizer_params, mesh=mesh,
+                     **kw)
+    return tr, tr.step

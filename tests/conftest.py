@@ -7,7 +7,7 @@ without TPU hardware.  Must run before jax initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +17,12 @@ import numpy as _np  # noqa: E402
 import pytest  # noqa: E402
 
 import jax  # noqa: E402
+
+# The agent environment's sitecustomize registers a single-client TPU-tunnel
+# PJRT plugin and force-updates jax_platforms to "axon,cpu" — a busy/stale
+# tunnel then hangs the whole run at first backend init.  Undo it before any
+# backend initializes: tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 # Full f32 matmuls for numeric checks; production/TPU runs keep jax's fast
 # default (bf16 passes on the MXU), mirroring how the reference tests CPU math
