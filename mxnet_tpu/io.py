@@ -1,0 +1,365 @@
+"""``mx.io`` — data iterators.
+
+Reference: src/io/ (native chained iterator pipeline: parse → decode →
+augment → batch → prefetch, SURVEY.md §3.5) and python/mxnet/io/
+(`DataIter`, `NDArrayIter`, `MXDataIter` over the C iterators).
+
+TPU-native re-design: host-side input pipelines stay in Python/NumPy (the
+accelerator never touches them) with a background-thread prefetcher replacing
+dmlc::ThreadedIter (src/io/iter_prefetcher.h:66).  Batches are plain host
+arrays until the training step shards them onto the mesh — minimizing
+host↔device transfers is the TPU analog of the reference's pinned-memory
+pipeline.  RecordIO-backed image pipelines live in mxnet_tpu.image /
+mxnet_tpu.recordio.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import namedtuple
+
+import numpy as _np
+
+from .ndarray.ndarray import NDArray, _wrap
+import jax.numpy as jnp
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "MNISTIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """Named shape/dtype descriptor (reference: python/mxnet/io/io.py
+    DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), _np.dtype(dtype),
+                               layout)
+
+
+class DataBatch:
+    """One batch: list of data arrays + list of label arrays + pad count."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [getattr(d, "shape", None) for d in self.data]
+        return "DataBatch: data shapes %s" % (shapes,)
+
+
+class DataIter:
+    """Iterator protocol (reference: python/mxnet/io/io.py DataIter).
+
+    Subclasses implement ``next()`` raising StopIteration, plus
+    ``provide_data``/``provide_label`` and ``reset()``.
+    """
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    # legacy pull-style API
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            self._next_batch = None
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getindex(self):
+        return self._next_batch.index
+
+    def getpad(self):
+        return self._next_batch.pad
+
+
+def _as_arrays(data, prefix):
+    """Normalize dict/list/array input to ordered [(name, ndarray)]."""
+    if data is None:
+        return []
+    if isinstance(data, dict):
+        items = list(data.items())
+    elif isinstance(data, (list, tuple)):
+        items = [("%s%d" % (prefix, i) if i else prefix, d)
+                 for i, d in enumerate(data)]
+    else:
+        items = [(prefix, data)]
+    out = []
+    for name, d in items:
+        if isinstance(d, NDArray):
+            d = d.asnumpy()
+        out.append((name, _np.asarray(d)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Batching iterator over in-memory arrays (reference:
+    python/mxnet/io/io.py NDArrayIter: shuffle, pad/discard/roll_over
+    last-batch handling)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _as_arrays(data, data_name)
+        self.label = _as_arrays(label, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        for _, d in self.data + self.label:
+            assert d.shape[0] == self.num_data, "inconsistent data length"
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._order = _np.arange(self.num_data)
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.label]
+
+    def reset(self):
+        """pad: wrap-pad the final short batch. discard: drop it.
+        roll_over: its samples lead the NEXT epoch (reference NDArrayIter
+        semantics — no duplication within an epoch)."""
+        leftover = None
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            leftover = self._order[self.cursor:self.num_data].copy()
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+        if leftover is not None and len(leftover):
+            rest = self._order[~_np.isin(self._order, leftover)] \
+                if self.shuffle else \
+                self._order[:len(self._order) - len(leftover)]
+            # leftover samples first, then the rest of the (re)ordered epoch
+            self._order = _np.concatenate(
+                [leftover, rest[:self.num_data - len(leftover)]])
+        self.cursor = -self.batch_size
+
+    def _slice(self, arrs):
+        start = self.cursor
+        end = start + self.batch_size
+        out = []
+        for _, d in arrs:
+            idx = self._order[start:min(end, self.num_data)]
+            part = d[idx]
+            if end > self.num_data:  # pad by wrapping
+                wrap = self._order[0:end - self.num_data]
+                part = _np.concatenate([part, d[wrap]], axis=0)
+            out.append(_wrap(jnp.asarray(part)))
+        return out
+
+    def next(self):
+        self.cursor += self.batch_size
+        if self.cursor >= self.num_data:
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        pad = max(0, end - self.num_data)
+        if pad and self.last_batch_handle in ("discard", "roll_over"):
+            # roll_over: leave cursor where it is; reset() rolls the unseen
+            # samples into the next epoch
+            raise StopIteration
+        return DataBatch(self._slice(self.data), self._slice(self.label),
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference: src/io/iter_csv.cc:218) — eager numpy load,
+    then NDArrayIter batching."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (reference: src/io/iter_mnist.cc:260)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 **kwargs):
+        import gzip
+        import struct
+
+        def read_idx(path):
+            op = gzip.open if path.endswith(".gz") else open
+            with op(path, "rb") as f:
+                magic = struct.unpack(">HBB", f.read(4))
+                ndim = magic[2]
+                dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+        img = read_idx(image).astype(_np.float32) / 255.0
+        lbl = read_idx(label).astype(_np.float32)
+        if flat:
+            img = img.reshape(img.shape[0], -1)
+        else:
+            img = img.reshape(img.shape[0], 1, *img.shape[1:])
+        self._inner = NDArrayIter({"data": img}, {"softmax_label": lbl},
+                                  batch_size, shuffle=shuffle)
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (reference: python/mxnet/io/io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread double buffering — the dmlc::ThreadedIter analog
+    (src/io/iter_prefetcher.h:66,142).  Overlaps host batch prep with device
+    compute; with jax async dispatch one prefetch depth is enough to keep the
+    chip fed."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches[0] if len(batches) == 1 else batches)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self.iters[0].provide_data
+
+    @property
+    def provide_label(self):
+        return self.iters[0].provide_label
+
+    def reset(self):
+        self._stop.set()
+        # drain so the worker unblocks, then restart
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._exhausted = False
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._queue.maxsize)
+        self._start()
+
+    def next(self):
+        if getattr(self, "_exhausted", False):
+            raise StopIteration
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            raise StopIteration
+        return item

@@ -1,0 +1,56 @@
+"""Checkpoint helpers (reference: python/mxnet/model.py:394-442
+save_checkpoint/load_checkpoint writing `prefix-symbol.json` +
+`prefix-NNNN.params`).
+
+The file formats are this framework's own (symbol JSON schema v1 from
+mxnet_tpu.symbol; params via mx.nd.save's .npz container) — the *workflow*
+(graph + params pair, epoch-numbered, resumable via Module.fit begin_epoch)
+is the parity surface.  Sharded large-model checkpoints live in
+mxnet_tpu.parallel (orbax-style pytree saves).
+"""
+from __future__ import annotations
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "pack_params", "unpack_params"]
+
+
+def pack_params(arg_params, aux_params):
+    """Single flat dict with 'arg:'/'aux:' prefixes — the one canonical
+    params-file convention (shared by model checkpoints and
+    BaseModule.save_params)."""
+    packed = {("arg:%s" % k): v for k, v in arg_params.items()}
+    packed.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    return packed
+
+
+def unpack_params(loaded):
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    from .ndarray.ndarray import save as nd_save
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    nd_save("%s-%04d.params" % (prefix, epoch),
+            pack_params(arg_params, aux_params))
+
+
+def load_params(prefix, epoch):
+    from .ndarray.ndarray import load as nd_load
+    return unpack_params(nd_load("%s-%04d.params" % (prefix, epoch)))
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params)."""
+    from . import symbol as sym_mod
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

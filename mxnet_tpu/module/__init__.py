@@ -1,0 +1,18 @@
+"""``mx.mod`` — Module training API over the Symbol executor.
+
+Reference: python/mxnet/module/ — `BaseModule.fit` (base_module.py:409-530),
+`Module` (module.py:40), `BucketingModule` (bucketing_module.py:40),
+`DataParallelExecutorGroup` (executor_group.py:144).
+
+TPU-native re-design: one jit-compiled executor per shape signature replaces
+the executor group — data parallelism is mesh sharding (mxnet_tpu.parallel),
+not per-context executor replicas, so the batch-slicing/gradient-reduce
+machinery of the reference collapses into the bound function.  BucketingModule
+keeps its role (per-length jit specialization — the CachedOp
+per-signature-cache precedent, src/imperative/cached_op.h:156).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -1,0 +1,157 @@
+"""BucketingModule — variable-length sequence training via per-bucket
+specialization.
+
+Reference: python/mxnet/module/bucketing_module.py:40 — one executor per
+bucket key, parameters shared across buckets.  TPU-native: each bucket is a
+jit specialization (one XLA program per padded length, the CachedOp
+per-signature precedent src/imperative/cached_op.h:156); parameters live in
+one shared dict so every bucket trains the same weights.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+        self._opt_args = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _gen_module(self, bucket_key, data_shapes, label_shapes):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        mod = Module(sym, data_names, label_names, logger=self.logger,
+                     context=self._context,
+                     fixed_param_names=self._fixed_param_names)
+        mod.bind(data_shapes, label_shapes,
+                 for_training=self.for_training)
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        mod = self._gen_module(self._default_bucket_key, data_shapes,
+                               label_shapes)
+        self._buckets = {self._default_bucket_key: mod}
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Select (creating on first use) the module for `bucket_key`,
+        sharing parameters with the default bucket."""
+        assert self.binded
+        if bucket_key not in self._buckets:
+            mod = self._gen_module(bucket_key, data_shapes, label_shapes)
+            if self.params_initialized:
+                arg, aux = self._buckets[
+                    self._default_bucket_key].get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                allow_missing=False, force_init=True)
+            if self.optimizer_initialized:
+                self._share_optimizer(mod)
+            self._buckets[bucket_key] = mod
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        self._buckets[self._default_bucket_key].init_params(
+            initializer, arg_params, aux_params, allow_missing, force_init,
+            allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        # parameters are pushed back to the default bucket after each update,
+        # so it always holds the canonical copy
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._buckets[self._default_bucket_key].set_params(
+            arg_params, aux_params, allow_missing, force_init, allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """ONE optimizer/updater shared by every bucket — stateful moments
+        and update counts must see all updates regardless of bucket, exactly
+        as the reference shares one kvstore/updater across bucket executors
+        (bucketing_module.py:40)."""
+        assert self.binded and self.params_initialized
+        self._opt_args = dict(kvstore=kvstore, optimizer=optimizer,
+                              optimizer_params=optimizer_params)
+        default = self._buckets[self._default_bucket_key]
+        default.init_optimizer(kvstore, optimizer, optimizer_params,
+                               force_init)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                self._share_optimizer(mod)
+        self.optimizer_initialized = True
+
+    def _share_optimizer(self, mod):
+        default = self._buckets[self._default_bucket_key]
+        mod._optimizer = default._optimizer
+        mod._updater = default._updater
+        mod.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded
+        key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        self.switch_bucket(key, data_batch.provide_data,
+                           data_batch.provide_label)
+        if self._curr_bucket_key != self._default_bucket_key \
+                and self.params_initialized:
+            # sync shared params into this bucket's executor
+            arg, aux = self._buckets[self._default_bucket_key].get_params()
+            self._curr_module.set_params(arg, aux)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        if self._curr_bucket_key != self._default_bucket_key:
+            # write updated params back to the canonical (default) bucket
+            arg, aux = self._curr_module.get_params()
+            self._buckets[self._default_bucket_key].set_params(arg, aux)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)

@@ -1,0 +1,228 @@
+"""Module — symbol + one jit-specialized executor.
+
+Reference: python/mxnet/module/module.py:40 (`Module`), whose bind creates a
+`DataParallelExecutorGroup` slicing the batch over contexts
+(executor_group.py:144) and whose update pushes gradients through KVStore
+(module.py:646).
+
+TPU-native: a single Executor (jit per shape signature) carries the whole
+batch; scale-out is mesh sharding via mxnet_tpu.parallel, not executor
+replicas, so update() applies the optimizer directly (the
+update_on_kvstore=False path of the reference).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .base_module import BaseModule
+from ..ndarray.ndarray import NDArray, _wrap
+from ..initializer import InitDesc
+from .. import optimizer as opt_mod
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # ------------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = _norm_shapes(data_shapes, self._data_names)
+        self._label_shapes = _norm_shapes(label_shapes, self._label_names) \
+            if label_shapes else []
+        shapes = {}
+        for name, shape in self._data_shapes + self._label_shapes:
+            shapes[name] = shape
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        args = {}
+        arg_names = self._symbol.list_arguments()
+        dtypes = {d.name: d.dtype for d in list(data_shapes or [])
+                  + list(label_shapes or []) if hasattr(d, "dtype")}
+        for name, shp in zip(arg_names, arg_shapes):
+            if shp is None:
+                raise ValueError(
+                    "cannot infer shape of %r from data shapes %s"
+                    % (name, shapes))
+            args[name] = _wrap(jnp.zeros(shp, dtypes.get(name, _np.float32)))
+        aux = {}
+        for name, shp in zip(self._aux_names, aux_shapes):
+            if shp is None:
+                raise ValueError("cannot infer shape of aux %r" % (name,))
+            aux[name] = _wrap(jnp.zeros(shp, _np.float32))
+        req = {}
+        for n in arg_names:
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        grads = {n: _wrap(jnp.zeros_like(args[n]._data))
+                 for n, r in req.items() if r != "null"}
+        from ..symbol.symbol import Executor
+        self._exec = Executor(self._symbol, self._context, args, grads, req,
+                              aux)
+        self.binded = True
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+
+    # -------------------------------------------------------------- params
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        attr_map = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                src = arg_params[name]
+                arr._data = src._data if isinstance(src, NDArray) \
+                    else jnp.asarray(src)
+            elif initializer is not None:
+                desc = InitDesc(name, attr_map.get(name, {}))
+                initializer(desc, arr)
+            elif not allow_missing:
+                raise RuntimeError("no initializer and no value for %r"
+                                   % (name,))
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params and name in aux_params:
+                src = aux_params[name]
+                arr._data = src._data if isinstance(src, NDArray) \
+                    else jnp.asarray(src)
+            elif initializer is not None:
+                desc = InitDesc(name, attr_map.get(name, {}))
+                initializer(desc, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: v.copy() for n, v in self._exec.aux_dict.items()}
+        return arg, aux
+
+    # ----------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        optimizer.param_idx2name = idx2name
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for (name, _), arr in zip(self._data_shapes, data_batch.data):
+            feeds[name] = arr
+        if self._label_shapes and data_batch.label:
+            for (name, _), arr in zip(self._label_shapes, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer to parameters (reference module.py:646; the
+        kvstore push/pull collapses — gradient reduction is XLA's job on a
+        sharded step, a no-op on one chip)."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            self._updater(i, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self._inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            {n: l for (n, _), l in zip(self._label_shapes, labels)}
+            if self._label_shapes else {},
+            dict(zip(self._symbol.list_outputs(), self._exec.outputs)))
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._symbol.list_outputs(), self._exec.outputs)]
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+
+
+def _norm_shapes(shapes, names):
+    if shapes is None:
+        return []
+    out = []
+    for i, s in enumerate(shapes):
+        if hasattr(s, "name"):  # DataDesc
+            out.append((s.name, tuple(s.shape)))
+        elif isinstance(s, tuple) and len(s) == 2 and isinstance(s[0], str):
+            out.append((s[0], tuple(s[1])))
+        else:
+            out.append((names[i], tuple(s)))
+    return out
+
+

@@ -1,0 +1,31 @@
+"""The ``mx.sym`` namespace: Symbol + every registered op as a lazy builder.
+
+Reference: python/mxnet/symbol/ — op functions code-generated from the NNVM
+registry.  Here a module ``__getattr__`` resolves any registered op name to a
+Symbol-node constructor, so ``sym.FullyConnected``, ``sym.relu`` etc. exist
+without codegen and stay automatically in sync with the eager ``mx.nd``
+namespace (same registry, one lowering per op).
+"""
+from __future__ import annotations
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     Executor, zeros, ones, _make_op_node)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor", "zeros", "ones"]
+
+from ..ops import registry as _registry
+
+
+def __getattr__(name):
+    try:
+        _registry.get(name)
+    except AttributeError:
+        raise AttributeError(
+            "module 'symbol' has no attribute %r" % (name,)) from None
+
+    def build(*args, **kwargs):
+        return _make_op_node(name, list(args), kwargs)
+
+    build.__name__ = name
+    return build

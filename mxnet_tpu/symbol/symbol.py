@@ -1,0 +1,840 @@
+"""Symbol — lazy graph-composition API over the op registry.
+
+Reference: python/mxnet/symbol/symbol.py (`Symbol`, compose without data,
+bind/simple_bind at symbol.py:1500+ incl. the ``group2ctx`` model-parallel
+arg) over the NNVM C++ graph (3rdparty/tvm/nnvm).  The reference keeps a
+C++-side node graph and runs optimization passes (src/executor/
+graph_executor.cc:388 Init pipeline) before creating engine ops.
+
+TPU-native re-design: a Symbol is an immutable Python DAG node naming a
+registered pure op.  "Binding" does not build an executor machine — it traces
+the DAG once into a pure jax function and ``jit``s it; XLA then does
+everything the reference's pass pipeline did (shape/type propagation at trace
+time, memory planning, fusion, scheduling).  Gradient executors come from
+``jax.vjp`` of the same traced function, replacing the MXGradient graph pass
+(src/nnvm/gradient.cc:104).  Multi-device placement (``group2ctx``) becomes
+sharding annotations, not device assignment.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..ops import registry as _registry
+from .. import random as _random
+from ..base import dtype_np
+from ..context import current_context
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor", "zeros", "ones"]
+
+
+class Symbol:
+    """Immutable graph node.
+
+    kind: 'var' (named input), 'op' (registered op applied to inputs),
+    'slice' (select one output of a multi-output node), 'group' (tuple of
+    heads, reference: mx.sym.Group).
+    ``inputs`` entries are Symbols or Python/numpy constants (scalars embed
+    directly, matching ``sym + 1``).
+    """
+
+    __slots__ = ("kind", "name", "op", "attrs", "inputs", "index", "_attr_map")
+
+    def __init__(self, kind, name, op=None, attrs=None, inputs=(), index=0):
+        self.kind = kind
+        self.name = name
+        self.op = op
+        self.attrs = attrs or {}
+        self.inputs = list(inputs)
+        self.index = index
+        self._attr_map = {}
+
+    # ------------------------------------------------------------- identity
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name,)
+
+    def attr(self, key):
+        return self._attr_map.get(key)
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo(self):
+            if node._attr_map:
+                out[node.name] = dict(node._attr_map)
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._attr_map.update(kwargs)
+        return self
+
+    # ------------------------------------------------------------ listings
+    def list_arguments(self):
+        """Names of all variable leaves in topological order (reference:
+        Symbol.list_arguments), aux states excluded."""
+        return [n.name for n in _topo(self)
+                if n.kind == "var" and not _is_aux_name(n.name)]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo(self)
+                if n.kind == "var" and _is_aux_name(n.name)]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self) if n.kind == "var"]
+
+    def list_outputs(self):
+        """One name per actual output — multi-output heads expand to
+        ``name_output0..N`` so output_dict/monitor callbacks stay aligned
+        with forward()'s output list."""
+        names = []
+        for h in self._heads():
+            n = _node_num_outputs(h)
+            if n > 1 and h.kind == "op" and self.kind != "group":
+                names.extend("%s_output%d" % (h.name, i) for i in range(n))
+            elif h.kind == "var":
+                names.append(h.name)
+            else:
+                names.append(h.name + "_output")
+        return names
+
+    @property
+    def num_outputs(self):
+        return len(self._heads())
+
+    def _heads(self):
+        if self.kind == "group":
+            return list(self.inputs)
+        return [self]
+
+    def __iter__(self):
+        heads = self._heads()
+        if len(heads) == 1:
+            # a single multi-output op iterates its outputs
+            n = _node_num_outputs(heads[0])
+            if n > 1:
+                return iter([heads[0][i] for i in range(n)])
+        return iter(heads)
+
+    def __getitem__(self, idx):
+        if self.kind == "group":
+            return self.inputs[idx]
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        if _node_num_outputs(self) > 1:
+            return Symbol("slice", "%s%d" % (self.name, idx),
+                          inputs=[self], index=idx)
+        if idx != 0:
+            raise IndexError("output index %d out of range" % idx)
+        return self
+
+    def get_internals(self):
+        """Group of every node's outputs (reference: Symbol.get_internals,
+        used to tap intermediate features e.g. for fine-tuning)."""
+        return Group([n if n.kind == "var" else n
+                      for n in _topo(self)])
+
+    def get_children(self):
+        ins = [i for i in self.inputs if isinstance(i, Symbol)]
+        return Group(ins) if ins else None
+
+    # ----------------------------------------------------------- operators
+    def _binop(self, opname, other, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return _make_op_node(opname, [a, b], {})
+
+    def __add__(self, o): return self._binop("broadcast_add", o)
+    def __radd__(self, o): return self._binop("broadcast_add", o, True)
+    def __sub__(self, o): return self._binop("broadcast_sub", o)
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o)
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, True)
+    def __truediv__(self, o): return self._binop("broadcast_div", o)
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, True)
+    def __pow__(self, o): return self._binop("broadcast_power", o)
+    def __neg__(self): return _make_op_node("negative", [self], {})
+    def __eq__(self, o): return self._binop("broadcast_equal", o)
+    def __ne__(self, o): return self._binop("broadcast_not_equal", o)
+    def __lt__(self, o): return self._binop("broadcast_lesser", o)
+    def __le__(self, o): return self._binop("broadcast_lesser_equal", o)
+    def __gt__(self, o): return self._binop("broadcast_greater", o)
+    def __ge__(self, o): return self._binop("broadcast_greater_equal", o)
+    __hash__ = object.__hash__
+
+    def __getattr__(self, name):
+        # method-style op application: sym.reshape(...), sym.mean(...) —
+        # mirrors NDArray's generated methods
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            _registry.get(name)
+        except AttributeError:
+            raise AttributeError("Symbol has no attribute %r" % (name,)) \
+                from None
+
+        def method(*args, **kwargs):
+            return _make_op_node(name, [self] + list(args), kwargs)
+        method.__name__ = name
+        return method
+
+    # ----------------------------------------------------- shape/type infer
+    def infer_shape(self, *args_shapes, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) — reference
+        Symbol.infer_shape.  Partial: parameter shapes are derived from data
+        shapes via per-op reverse rules + jax.eval_shape forward propagation
+        (replacing src/executor/infer_graph_attr_pass.cc).  Unknown shapes
+        come back as None."""
+        if args_shapes:
+            kwargs.update(zip(self.list_arguments(), args_shapes))
+        known = {n: tuple(v) for n, v in kwargs.items() if v is not None}
+        var_shapes, out_shapes = _infer_shapes_partial(self, known)
+        args = self.list_arguments()
+        aux = self.list_auxiliary_states()
+        arg_res = [var_shapes.get(n) for n in args]
+        aux_res = [var_shapes.get(n) for n in aux]
+        out_res = []
+        for h in self._heads():
+            n = _node_num_outputs(h)
+            if n > 1 and h.kind == "op" and self.kind != "group":
+                out_res.extend(out_shapes.get((id(h), i)) for i in range(n))
+            else:
+                base = h.inputs[0] if h.kind == "slice" else h
+                idx = h.index if h.kind == "slice" else 0
+                out_res.append(out_shapes.get((id(base), idx)))
+        return arg_res, out_res, aux_res
+
+    def infer_type(self, **kwargs):
+        """All-float32 default typing (the framework computes in f32/bf16 by
+        policy — see mx.amp — rather than per-arg dtype solving)."""
+        args = self.list_arguments()
+        aux = self.list_auxiliary_states()
+        f32 = _np.dtype(_np.float32)
+        return ([_np.dtype(kwargs.get(n, f32)) for n in args],
+                [f32] * len(self.list_outputs()), [f32] * len(aux))
+
+    # -------------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        """Allocate arguments from shapes and bind (reference:
+        MXExecutorSimpleBindEx, src/c_api/c_api_executor.cc:860)."""
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        from ..ndarray.ndarray import _wrap
+        args = {}
+        for name, shp in zip(self.list_arguments(), arg_shapes):
+            if shp is None:
+                raise ValueError(
+                    "simple_bind could not infer a shape for %r — pass it "
+                    "explicitly" % (name,))
+            dt = (type_dict or {}).get(name, _np.float32)
+            args[name] = _wrap(jnp.zeros(shp, dtype_np(dt)))
+        aux = {}
+        for name, shp in zip(self.list_auxiliary_states(), aux_shapes):
+            if shp is None:
+                raise ValueError(
+                    "simple_bind could not infer a shape for aux %r" % (name,))
+            aux[name] = _wrap(jnp.zeros(shp, _np.float32))
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: _wrap(jnp.zeros_like(v._data))
+                         for n, v in args.items()}
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Bind with explicit arrays (reference: MXExecutorBindEX,
+        src/c_api/c_api_executor.cc:135)."""
+        from ..ndarray.ndarray import NDArray, _wrap
+        names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(names, args))
+        args = {n: (v if isinstance(v, NDArray) else _wrap(jnp.asarray(v)))
+                for n, v in (args or {}).items()}
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        aux_states = {n: (v if isinstance(v, NDArray)
+                          else _wrap(jnp.asarray(v)))
+                      for n, v in (aux_states or {}).items()}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(names, args_grad))
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot forward (reference: Symbol.eval)."""
+        ex = self.bind(ctx, args=kwargs)
+        return ex.forward()
+
+    # -------------------------------------------------------- serialization
+    def tojson(self):
+        """Graph JSON — same concept as the reference's symbol.json
+        (MXSymbolSaveToJSON, src/c_api/c_api_symbolic.cc:500); own schema."""
+        nodes = _topo(self)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            ins = []
+            for x in n.inputs:
+                if isinstance(x, Symbol):
+                    ins.append(["node", nid[id(x)]])
+                else:
+                    ins.append(["const", _np.asarray(x).tolist()])
+            out_nodes.append({
+                "kind": n.kind, "name": n.name, "op": n.op,
+                "attrs": _json_attrs(n.attrs), "inputs": ins,
+                "index": n.index, "attr_map": n._attr_map,
+            })
+        heads = [nid[id(h)] for h in self._heads()]
+        return json.dumps({"nodes": out_nodes, "heads": heads,
+                           "format": "mxnet_tpu-symbol-v1"}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def _json_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, _np.dtype):
+            v = v.name
+        elif isinstance(v, type):
+            v = _np.dtype(v).name
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+def load_json(s):
+    data = json.loads(s)
+    nodes = []
+    for spec in data["nodes"]:
+        ins = []
+        for kind, val in spec["inputs"]:
+            ins.append(nodes[val] if kind == "node" else val)
+        n = Symbol(spec["kind"], spec["name"], spec.get("op"),
+                   spec.get("attrs") or {}, ins, spec.get("index", 0))
+        n._attr_map = spec.get("attr_map") or {}
+        nodes.append(n)
+    heads = [nodes[i] for i in data["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ------------------------------------------------------------ constructors
+
+def Variable(name, shape=None, dtype=None, init=None, **attr_kwargs):
+    s = Symbol("var", name)
+    if shape is not None:
+        s.attrs["shape"] = tuple(shape)
+    if dtype is not None:
+        s.attrs["dtype"] = _np.dtype(dtype).name
+    s._attr_map.update({k: str(v) for k, v in attr_kwargs.items()})
+    return s
+
+
+var = Variable
+
+
+def Group(symbols):
+    symbols = list(symbols)
+    return Symbol("group", "group", inputs=symbols)
+
+
+def zeros(shape, dtype="float32", **_):
+    return _make_op_node("_zeros_shape", [],
+                         {"shape": tuple(shape), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **_):
+    return _make_op_node("_ones_shape", [],
+                         {"shape": tuple(shape), "dtype": dtype})
+
+
+_registry.register("_zeros_shape", differentiable=False)(
+    lambda shape=(), dtype="float32", **_:
+        jnp.zeros(shape, dtype_np(dtype)))
+_registry.register("_ones_shape", differentiable=False)(
+    lambda shape=(), dtype="float32", **_:
+        jnp.ones(shape, dtype_np(dtype)))
+
+
+_NAME_COUNTER = {}
+
+
+def _auto_name(opname):
+    base = opname.lower().lstrip("_")
+    i = _NAME_COUNTER.get(base, 0)
+    _NAME_COUNTER[base] = i + 1
+    return "%s%d" % (base, i)
+
+
+# Learnable-input slots per layer op.  Reference parity: the NNVM registry
+# lists named inputs (FListInputNames) and the Python wrapper auto-creates
+# missing weight/bias Variables named "{name}_{slot}"
+# (python/mxnet/symbol/symbol.py generated ops).
+_OP_INPUT_SLOTS = {
+    "FullyConnected": ("data", "weight", "bias"),
+    "Convolution": ("data", "weight", "bias"),
+    "Deconvolution": ("data", "weight", "bias"),
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "GroupNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "Embedding": ("data", "weight"),
+}
+
+
+def _make_op_node(opname, inputs, attrs):
+    op = _registry.get(opname)  # raises AttributeError for unknown ops
+    name = attrs.pop("name", None) or _auto_name(opname)
+    slots = _OP_INPUT_SLOTS.get(op.name)
+    if slots:
+        slot_vals = {}
+        for i, x in enumerate(inputs):
+            slot_vals[slots[i]] = x
+        for s in slots:
+            if s in attrs:
+                slot_vals[s] = attrs.pop(s)
+        no_bias = bool(attrs.get("no_bias", False))
+        inputs = []
+        for s in slots:
+            v = slot_vals.get(s)
+            if v is None:
+                if s == "bias" and no_bias:
+                    inputs.append(None)
+                    continue
+                if s == "data":
+                    raise ValueError("%s: missing data input" % (op.name,))
+                v = Variable("%s_%s" % (name, s))
+            inputs.append(v)
+    else:
+        if "data" in attrs and not inputs:
+            inputs = [attrs.pop("data")]
+    norm_inputs = []
+    for x in inputs:
+        from ..ndarray.ndarray import NDArray
+        if isinstance(x, NDArray):
+            x = x._data  # constant capture
+        norm_inputs.append(x)
+    return Symbol("op", name, op=op.name, attrs=attrs, inputs=norm_inputs)
+
+
+# Parameter-shape rules: given op attrs + the data-input shape, the shapes of
+# learnable inputs.  This is the *reverse* half of the reference's per-op
+# FInferShape (e.g. src/operator/nn/fully_connected.cc shape fn deriving
+# weight=(num_hidden, in_dim)); the forward half is jax.eval_shape per node.
+def _fc_param_shapes(attrs, dshape):
+    nh = int(attrs["num_hidden"])
+    flatten = attrs.get("flatten", True)
+    in_dim = int(_np.prod(dshape[1:])) if flatten else dshape[-1]
+    return {1: (nh, in_dim), 2: (nh,)}
+
+
+def _conv_param_shapes(attrs, dshape):
+    nf = int(attrs["num_filter"])
+    kernel = tuple(attrs["kernel"])
+    groups = int(attrs.get("num_group", 1))
+    return {1: (nf, dshape[1] // groups) + kernel, 2: (nf,)}
+
+
+def _deconv_param_shapes(attrs, dshape):
+    nf = int(attrs["num_filter"])
+    kernel = tuple(attrs["kernel"])
+    return {1: (dshape[1], nf) + kernel, 2: (nf,)}
+
+
+def _bn_param_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", 1))
+    c = dshape[axis]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+def _ln_param_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", -1))
+    return {1: (dshape[axis],), 2: (dshape[axis],)}
+
+
+def _in_param_shapes(attrs, dshape):
+    return {1: (dshape[1],), 2: (dshape[1],)}
+
+
+def _emb_param_shapes(attrs, dshape):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+_INT_DATA_OPS = {"Embedding", "one_hot", "take"}
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_param_shapes,
+    "Convolution": _conv_param_shapes,
+    "Deconvolution": _deconv_param_shapes,
+    "BatchNorm": _bn_param_shapes,
+    "LayerNorm": _ln_param_shapes,
+    "GroupNorm": _in_param_shapes,
+    "InstanceNorm": _in_param_shapes,
+    "Embedding": _emb_param_shapes,
+}
+
+
+def _infer_shapes_partial(sym, known, dtypes=None):
+    """Forward shape propagation with reverse param rules — the TPU-native
+    stand-in for the reference's iterative InferShape pass
+    (src/executor/infer_graph_attr_pass.cc).  Returns
+    {var_name: shape} ∪ known, {(node_id, out_idx): shape}."""
+    var_shapes = dict(known)
+    out_shapes = {}
+
+    def in_shape(x):
+        if not isinstance(x, Symbol):
+            a = _np.asarray(x)
+            return tuple(a.shape)
+        if x.kind == "var":
+            if x.name in var_shapes:
+                return var_shapes[x.name]
+            if "shape" in x.attrs:
+                return tuple(x.attrs["shape"])
+            return None
+        idx = x.index if x.kind == "slice" else 0
+        base = x.inputs[0] if x.kind == "slice" else x
+        return out_shapes.get((id(base), idx))
+
+    for node in _topo(sym):
+        if node.kind == "var":
+            s = in_shape(node)
+            if s is not None:
+                out_shapes[(id(node), 0)] = s
+            continue
+        if node.kind == "slice":
+            s = out_shapes.get((id(node.inputs[0]), node.index))
+            if s is not None:
+                out_shapes[(id(node), 0)] = s
+            continue
+        if node.kind != "op":
+            continue
+        shapes = [in_shape(x) if x is not None else None
+                  for x in node.inputs]
+        rule = _PARAM_SHAPE_RULES.get(node.op)
+        if rule is not None and shapes and shapes[0] is not None:
+            derived = rule(node.attrs, shapes[0])
+            for i, shp in derived.items():
+                if i < len(node.inputs) and isinstance(node.inputs[i], Symbol) \
+                        and node.inputs[i].kind == "var" \
+                        and shapes[i] is None:
+                    shapes[i] = tuple(shp)
+                    var_shapes[node.inputs[i].name] = tuple(shp)
+                    out_shapes[(id(node.inputs[i]), 0)] = tuple(shp)
+        if any(s is None and x is not None
+               for s, x in zip(shapes, node.inputs)):
+            continue  # unknown inputs: leave this node's outputs unknown
+        op = _registry.get(node.op)
+        specs = []
+        for s, x in zip(shapes, node.inputs):
+            if x is None:
+                specs.append(None)
+            elif isinstance(x, Symbol):
+                specs.append(jax.ShapeDtypeStruct(s, _np.float32))
+            else:
+                specs.append(x)
+        if node.op in _INT_DATA_OPS and isinstance(specs[0],
+                                                   jax.ShapeDtypeStruct):
+            specs[0] = jax.ShapeDtypeStruct(specs[0].shape, _np.int32)
+        attrs = dict(node.attrs)
+        if node.op in _AUX_UPDATE_RULES or node.op in _STOCHASTIC_OPS:
+            attrs["training"] = False
+        try:
+            res = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *specs)
+        except Exception:
+            continue
+        outs = list(res) if isinstance(res, (tuple, list)) else [res]
+        for i, o in enumerate(outs):
+            out_shapes[(id(node), i)] = tuple(o.shape)
+    return var_shapes, out_shapes
+
+
+# ----------------------------------------------------------------- traversal
+
+def _topo(sym):
+    """Post-order unique traversal."""
+    seen = set()
+    order = []
+
+    def visit(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for x in n.inputs:
+            if isinstance(x, Symbol):
+                visit(x)
+        order.append(n)
+
+    visit(sym)
+    if sym.kind == "group":
+        # identity-based removal: Symbol.__eq__ builds graph nodes, so
+        # list.remove's == comparison must never run on Symbols
+        order = [n for n in order if n is not sym]
+    return order
+
+
+# Ops whose extra outputs are internal (reference: FNumVisibleOutputs — e.g.
+# BatchNorm's (mean, var) outputs exist in the graph but are hidden from the
+# user API, src/operator/nn/batch_norm.cc).
+_VISIBLE_OUTPUTS = {"BatchNorm": 1}
+
+
+def _node_num_outputs(node):
+    if node.kind != "op":
+        return 1
+    if node.op in _VISIBLE_OUTPUTS:
+        return _VISIBLE_OUTPUTS[node.op]
+    op = _registry.get(node.op)
+    n = op.num_outputs
+    if n == -1:  # attr-dependent (split)
+        return int(node.attrs.get("num_outputs", 1))
+    return n
+
+
+# Aux-state update rules: reference ops mutate their auxiliary inputs inside
+# the kernel (e.g. BatchNorm moving stats, src/operator/nn/batch_norm.cc);
+# our ops are pure, so the executor applies these write-backs explicitly.
+def _bn_aux_update(node, env_in, outs):
+    mom = float(node.attrs.get("momentum", 0.9))
+    mm, mv = node.inputs[3], node.inputs[4]
+    updates = {}
+    if isinstance(mm, Symbol) and mm.kind == "var":
+        updates[mm.name] = mom * env_in[3] + (1 - mom) * outs[1]
+    if isinstance(mv, Symbol) and mv.kind == "var":
+        updates[mv.name] = mom * env_in[4] + (1 - mom) * outs[2]
+    return updates
+
+
+_AUX_UPDATE_RULES = {"BatchNorm": _bn_aux_update}
+
+_AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var",
+                 "moving_avg")
+
+
+def _is_aux_name(name):
+    return name.endswith(_AUX_SUFFIXES)
+
+
+_STOCHASTIC_OPS = {"Dropout", "shuffle"}
+
+
+def _eval_symbol(sym, env, training, aux_updates=None):
+    """Interpret the DAG on jax values.  ``env`` maps var name -> array.
+    Returns the list of head outputs.  Runs under jit when called from a
+    bound Executor — pure apart from the explicit aux_updates dict."""
+    cache = {}
+
+    def value(node, index=0):
+        key = (id(node), index)
+        if key in cache:
+            return cache[key]
+        if node.kind == "var":
+            if node.name not in env:
+                raise ValueError("unbound variable %r" % (node.name,))
+            out = env[node.name]
+        elif node.kind == "slice":
+            out = value(node.inputs[0], node.index)
+        elif node.kind == "op":
+            op = _registry.get(node.op)
+            vals = [value(x) if isinstance(x, Symbol) else x
+                    for x in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op in _STOCHASTIC_OPS or node.op == "Dropout":
+                attrs.setdefault("training", training)
+            elif node.op in ("BatchNorm",):
+                attrs["training"] = training
+            res = op.fn(*vals, **attrs)
+            multi = isinstance(res, (tuple, list))
+            outs = list(res) if multi else [res]
+            for i, o in enumerate(outs):
+                cache[(id(node), i)] = o
+            if training and aux_updates is not None \
+                    and node.op in _AUX_UPDATE_RULES:
+                aux_updates.update(
+                    _AUX_UPDATE_RULES[node.op](node, vals, outs))
+            out = outs[index]
+        else:
+            raise ValueError("cannot evaluate node kind %r" % (node.kind,))
+        cache[key] = out
+        return out
+
+    heads = sym._heads()
+    outs = []
+    for h in heads:
+        n = _node_num_outputs(h)
+        if n > 1 and h.kind == "op" and sym.kind != "group":
+            outs.extend(value(h, i) for i in range(n))
+        else:
+            outs.append(value(h, h.index if h.kind == "slice" else 0))
+    return outs
+
+
+# ------------------------------------------------------------------ Executor
+
+class Executor:
+    """Bound computation (reference: include/mxnet/executor.h over
+    GraphExecutor).  forward/backward call into ONE jitted function per
+    (training, shape-signature); XLA replaces the reference's memory planning
+    + bulked engine ops (src/executor/graph_executor.cc:1016,1288)."""
+
+    def __init__(self, sym, ctx, args, args_grad, grad_req, aux):
+        self._symbol = sym
+        self._ctx = ctx
+        self.arg_dict = dict(args or {})
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = dict(aux or {})
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_dict}
+        self.grad_req = grad_req
+        self.outputs = []
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        self._monitor = None
+
+    # internals -----------------------------------------------------------
+    def _env(self):
+        env = {n: v._data for n, v in self.arg_dict.items()}
+        env.update({n: v._data for n, v in self.aux_dict.items()})
+        return env
+
+    def _fwd_fn(self, training):
+        if training not in self._fwd_cache:
+            sym = self._symbol
+
+            def run(env, key):
+                with _random.trace_key_scope(key):
+                    aux_updates = {}
+                    outs = _eval_symbol(sym, env, training, aux_updates)
+                    return outs, aux_updates
+
+            self._fwd_cache[training] = jax.jit(run)
+        return self._fwd_cache[training]
+
+    # public --------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        from ..ndarray.ndarray import NDArray, _wrap
+        for n, v in kwargs.items():
+            arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = arr
+            else:
+                from ..ndarray.ndarray import _wrap as _w
+                self.arg_dict[n] = _w(arr)
+        key = _random.new_eager_seed_key()
+        outs, aux_updates = self._fwd_fn(bool(is_train))(self._env(), key)
+        for n, v in aux_updates.items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._data = v
+        from ..ndarray.ndarray import _wrap as _w2
+        self.outputs = [_w2(o) for o in outs]
+        if self._monitor:
+            for name, arr in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor(name, arr)
+        return self.outputs
+
+    def _bwd_fn(self, wrt):
+        """One jitted program computing outputs AND input gradients —
+        forward + backward fuse into a single XLA executable (replacing the
+        reference's separate backward graph executor,
+        src/executor/graph_executor.cc:91)."""
+        key_sig = tuple(wrt)
+        if key_sig not in self._bwd_cache:
+            sym = self._symbol
+
+            def run(wrt_vals, rest_env, cts, key):
+                def fwd(wv):
+                    env = dict(rest_env)
+                    env.update(wv)
+                    with _random.trace_key_scope(key):
+                        return _eval_symbol(sym, env, True, None)
+
+                outs, vjp = jax.vjp(fwd, wrt_vals)
+                if cts is None:
+                    cts_ = [jnp.ones_like(o) for o in outs]
+                else:
+                    cts_ = list(cts)
+                (grads,) = vjp(cts_)
+                return outs, grads
+
+            self._bwd_cache[key_sig] = jax.jit(run,
+                                               static_argnames=())
+        return self._bwd_cache[key_sig]
+
+    def backward(self, out_grads=None):
+        from ..ndarray.ndarray import NDArray, _wrap
+        wrt = tuple(sorted(n for n in self.arg_dict
+                           if self.grad_req.get(n, "null") != "null"))
+        if not wrt:
+            return
+        rest_env = {n: v._data for n, v in self.aux_dict.items()}
+        rest_env.update({n: v._data for n, v in self.arg_dict.items()
+                         if n not in wrt})
+        wrt_vals = {n: self.arg_dict[n]._data for n in wrt}
+        if out_grads is not None:
+            if isinstance(out_grads, (NDArray, jnp.ndarray, _np.ndarray)):
+                out_grads = [out_grads]
+            out_grads = [g._data if isinstance(g, NDArray)
+                         else jnp.asarray(g) for g in out_grads]
+        key = _random.new_eager_seed_key()
+        _, grads = self._bwd_fn(wrt)(wrt_vals, rest_env, out_grads, key)
+        for n in wrt:
+            g = grads[n]
+            if g.dtype == jax.dtypes.float0:
+                continue
+            req = self.grad_req.get(n, "write")
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                self.grad_dict[n] = _wrap(g)
+            elif req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        from ..ndarray.ndarray import NDArray
+        for n, v in (arg_params or {}).items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = \
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            elif not allow_extra_params:
+                raise ValueError("unknown argument %r" % (n,))
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._data = \
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            elif not allow_extra_params:
+                raise ValueError("unknown aux state %r" % (n,))
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (jit re-specializes per signature)."""
+        from ..ndarray.ndarray import _wrap
+        new_args = {}
+        for n, v in self.arg_dict.items():
+            if n in kwargs:
+                new_args[n] = _wrap(jnp.zeros(tuple(kwargs[n]),
+                                              v._data.dtype))
+            else:
+                new_args[n] = v
+        return Executor(self._symbol, self._ctx, new_args,
+                        dict(self.grad_dict), self.grad_req,
+                        dict(self.aux_dict))
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback

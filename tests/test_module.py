@@ -1,0 +1,149 @@
+"""Module API + io tests (reference analog: tests/python/unittest/
+test_module.py and test_io.py — fit convergence, checkpointing, NDArrayIter
+batching semantics, BucketingModule param sharing)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _toy_data(n=160, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return X, Y
+
+
+def _mlp_softmax():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(h, label, name="softmax")
+
+
+def test_ndarrayiter_batching():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    Y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_module_fit_converges():
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=16), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 5)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 5)
+    mod2 = mx.mod.Module(sym2)
+    mod2.bind([("data", (16, 10))], [("softmax_label", (16,))],
+              for_training=False)
+    mod2.set_params(arg2, aux2)
+    p1 = mod.predict(mx.io.NDArrayIter(X, Y, batch_size=16)).asnumpy()
+    p2 = mod2.predict(mx.io.NDArrayIter(X, Y, batch_size=16)).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_module_predict_strips_pad():
+    X, Y = _toy_data(n=50)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    mod.init_params(mx.init.Xavier())
+    out = mod.predict(mx.io.NDArrayIter(X, Y, batch_size=16))
+    assert out.shape == (50, 3)
+
+
+def test_module_input_grads():
+    X, Y = _toy_data(n=16)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.bind([("data", (16, 10))], [("softmax_label", (16,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = next(mx.io.NDArrayIter(X, Y, batch_size=16))
+    mod.forward_backward(batch)
+    (gin,) = mod.get_input_grads()
+    assert gin.shape == (16, 10)
+    assert np.abs(gin.asnumpy()).sum() > 0
+
+
+def test_bucketing_module_shares_params():
+    """Per-bucket jit specialization with one canonical parameter set
+    (reference: bucketing_module.py:40)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="shared_fc",
+                                  flatten=False)
+        h = mx.sym.mean(h, axis=1)
+        h = mx.sym.FullyConnected(h, num_hidden=3, name="out_fc")
+        return (mx.sym.SoftmaxOutput(h, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind([("data", (4, 8, 5))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for seq_len in (8, 4, 8, 4):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(rng.uniform(size=(4, seq_len, 5))
+                         .astype(np.float32))],
+            [mx.nd.array(rng.randint(0, 3, (4,)).astype(np.float32))],
+            provide_data=[mx.io.DataDesc("data", (4, seq_len, 5))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        batch.bucket_key = seq_len
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    arg, _ = mod.get_params()
+    assert "shared_fc_weight" in arg
+    assert len(mod._buckets) == 2
+
+
+def test_csviter(tmp_path):
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    label = np.arange(8, dtype=np.float32)
+    dpath = tmp_path / "d.csv"
+    lpath = tmp_path / "l.csv"
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(dpath), data_shape=(3,),
+                       label_csv=str(lpath), batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3)
+
+
+def test_prefetching_iter():
+    X, Y = _toy_data(n=32)
+    base = mx.io.NDArrayIter(X, Y, batch_size=8)
+    pf = mx.io.PrefetchingIter(base)
+    n = sum(1 for _ in pf)
+    assert n == 4
+    pf.reset()
+    assert sum(1 for _ in pf) == 4

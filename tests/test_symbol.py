@@ -1,0 +1,140 @@
+"""Symbol API tests (reference analog: tests/python/unittest/test_symbol.py
+— composition, listings, infer_shape, serialization round trip; executor
+semantics from test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sym = mx.sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="act1")
+    return sym.FullyConnected(h, num_hidden=3, name="fc2")
+
+
+def test_compose_and_listings():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert out.list_outputs() == ["fc2_output"]
+    assert out.list_auxiliary_states() == []
+
+
+def test_infer_shape_partial():
+    """Parameter shapes derive from data shape alone — the reference
+    InferShape contract (src/executor/infer_graph_attr_pass.cc)."""
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(4, 10))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(4, 3)]
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                        name="conv0")
+    b = sym.BatchNorm(c, name="bn0")
+    arg_shapes, out_shapes, aux_shapes = b.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(b.list_arguments(), arg_shapes))
+    assert d["conv0_weight"] == (16, 3, 3, 3)
+    assert d["bn0_gamma"] == (16,)
+    assert dict(zip(b.list_auxiliary_states(), aux_shapes)) == {
+        "bn0_moving_mean": (16,), "bn0_moving_var": (16,)}
+    assert out_shapes == [(2, 16, 8, 8)]
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(data=(4, 10))
+    rs = np.random.RandomState(0)
+    for n, v in ex.arg_dict.items():
+        v._data = v._data + rs.uniform(-0.1, 0.1, v.shape).astype(np.float32)
+    y = ex.forward(is_train=True,
+                   data=rs.uniform(size=(4, 10)).astype(np.float32))
+    assert y[0].shape == (4, 3)
+    ex.backward()
+    for name in ("fc1_weight", "fc2_weight"):
+        g = ex.grad_dict[name].asnumpy()
+        assert np.abs(g).sum() > 0
+
+
+def test_executor_grad_add_req():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = out.simple_bind(data=(2, 3), grad_req="add")
+    x = np.ones((2, 3), np.float32)
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    g1 = ex.grad_dict["fc_weight"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    g2 = ex.grad_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-6)
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2 - 1) / 2
+    (res,) = c.eval(a=np.full((2, 2), 3, np.float32),
+                    b=np.full((2, 2), 2, np.float32))
+    np.testing.assert_allclose(res.asnumpy(), 3.0)
+
+
+def test_multi_output_split_and_getitem():
+    data = sym.Variable("data")
+    sp = sym.split(data, num_outputs=2, axis=1)
+    s = sp[0] + sp[1]
+    (res,) = s.eval(data=np.arange(8, dtype=np.float32).reshape(2, 4))
+    np.testing.assert_allclose(res.asnumpy(), [[2, 4], [10, 12]])
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    x = np.random.RandomState(0).uniform(size=(2, 10)).astype(np.float32)
+    args = {n: np.random.RandomState(i).uniform(-1, 1, s).astype(np.float32)
+            for i, (n, s) in enumerate(zip(out.list_arguments(),
+                                           out.infer_shape(data=(2, 10))[0]))}
+    args["data"] = x
+    (y1,) = out.eval(**args)
+    (y2,) = out2.eval(**args)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-6)
+
+
+def test_batchnorm_aux_update_on_forward():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, fix_gamma=False, momentum=0.5, name="bn")
+    ex = bn.simple_bind(data=(8, 4))
+    ex.aux_dict["bn_moving_var"]._data = \
+        ex.aux_dict["bn_moving_var"]._data + 1.0
+    x = np.random.RandomState(0).normal(2.0, 1.0, (8, 4)).astype(np.float32)
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    # moving_mean = 0.5*0 + 0.5*batch_mean
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-4)
+    # inference uses the stored stats, not batch stats
+    ex.forward(is_train=False, data=x)
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = [n for n in internals.list_outputs() if "fc1" in n]
+    assert names  # fc1 intermediate visible for feature extraction
+
+
+def test_variable_shape_attr_infer():
+    data = sym.Variable("data", shape=(4, 6))
+    out = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_shapes, out_shapes, _ = out.infer_shape()
+    assert out_shapes == [(4, 2)]
