@@ -50,6 +50,7 @@ _LAZY = {
     "viz": ".visualization",
     "recordio": ".recordio",
     "engine": ".engine",
+    "monitor": ".monitor",
     "contrib": ".contrib",
 }
 

@@ -1,0 +1,217 @@
+"""``mx.amp`` — automatic mixed precision.
+
+Reference: python/mxnet/contrib/amp/ — `amp.init()` recolors the graph via
+the low-precision pass (src/nnvm/low_precision_pass.cc) into fp16/fp32 op
+lists, plus dynamic loss scaling (`amp.init_trainer`, `amp.scale_loss`).
+
+TPU-native re-design: the MXU's native mixed precision is **bfloat16**, which
+shares float32's exponent range — so the reference's central complication
+(dynamic loss scaling against fp16 overflow) is unnecessary in the default
+policy, and "AMP" reduces to a dtype policy: parameters/activations in bf16,
+normalizations and reductions in f32 (our ops already accumulate matmuls in
+f32 via preferred_element_type).  fp16 with dynamic scaling is kept for API
+parity and for exporting models to fp16 targets.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as _np
+import jax.numpy as jnp
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "convert_symbol",
+           "LossScaler", "bfloat16", "float16"]
+
+bfloat16 = jnp.bfloat16
+float16 = _np.float16
+
+_STATE = {"initialized": False, "target_dtype": None}
+
+# Ops that must stay f32 even under a low-precision policy (the FP32 list of
+# the reference's low_precision_pass.cc: norms, softmax/loss, large
+# reductions).
+FP32_OPS = {"BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+            "softmax", "log_softmax", "SoftmaxOutput", "norm", "mean",
+            "sum", "logsumexp", "CTCLoss"}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Turn on the global mixed-precision policy.  fp32_ops extends the
+    f32-pinned set consumed by convert_symbol/convert_model;
+    target_precision_ops restricts nothing here (every op not in FP32_OPS
+    already runs in the target dtype)."""
+    target_dtype = jnp.bfloat16 if str(target_dtype) in (
+        "bfloat16", "bf16") else _np.float16
+    _STATE["initialized"] = True
+    _STATE["target_dtype"] = target_dtype
+    if fp32_ops:
+        FP32_OPS.update(fp32_ops)
+    if conditional_fp32_ops:
+        FP32_OPS.update(op if isinstance(op, str) else op[0]
+                        for op in conditional_fp32_ops)
+
+
+def active_dtype():
+    return _STATE["target_dtype"] if _STATE["initialized"] else None
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: amp/loss_scaler.py) — only needed
+    for fp16; bf16 runs unscaled."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self.overflow_pending = False
+
+    def has_overflow(self, params):
+        for p in params:
+            arr = p.grad() if hasattr(p, "grad") else p
+            a = arr._data if hasattr(arr, "_data") else arr
+            if not bool(jnp.isfinite(a).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler to a Gluon Trainer (fp16 policy only) and make
+    trainer.step SKIP the update after an overflow step — applying inf/nan
+    gradients would permanently poison the weights (the whole point of the
+    reference's dynamic loss scaler)."""
+    scaler = LossScaler() if _STATE["target_dtype"] == _np.float16 \
+        else None
+    trainer._amp_loss_scaler = scaler
+    if scaler is not None and not getattr(trainer, "_amp_wrapped", False):
+        orig_step = trainer.step
+
+        def step(batch_size, ignore_stale_grad=False):
+            if scaler.overflow_pending:
+                scaler.overflow_pending = False
+                return  # skip this update; scale was already reduced
+            return orig_step(batch_size, ignore_stale_grad=ignore_stale_grad)
+
+        trainer.step = step
+        trainer._amp_wrapped = True
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale loss before backward, unscale grads after (reference:
+    amp.scale_loss).  A no-op pass-through under bf16."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    scale = scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scale for l in loss]
+    else:
+        yield loss * scale
+    inv = 1.0 / scale
+    for p in trainer._params:
+        if p.grad_req != "null":
+            g = p.grad()
+            g._data = g._data * inv
+    overflow = scaler.has_overflow(
+        [p for p in trainer._params if p.grad_req != "null"])
+    scaler.overflow_pending = overflow
+    scaler.update_scale(overflow)
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null":
+            g = p.grad()
+            g._data = g._data * inv
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  **kwargs):
+    """Symbolic-model conversion: wrap the symbol with casts and convert the
+    params (reference: amp.convert_model)."""
+    new_sym = convert_symbol(sym, target_dtype)
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") \
+        else _np.float16
+    from .ndarray.ndarray import _wrap
+
+    def conv(params):
+        out = {}
+        for k, v in params.items():
+            out[k] = _wrap(v._data.astype(dt)) \
+                if v._data.dtype == _np.float32 else v
+        return out
+    return new_sym, conv(arg_params), aux_params
+
+
+def convert_symbol(sym, target_dtype="bfloat16", **kwargs):
+    """Rebuild the DAG with casts — the graph-recolor analog of the
+    reference's low-precision pass (src/nnvm/low_precision_pass.cc): inputs
+    of compute ops are cast to the target dtype, inputs of FP32_OPS are cast
+    back to f32, and head outputs are returned in f32."""
+    from .symbol.symbol import Symbol, Group, _topo, _make_op_node
+
+    dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else \
+        "float16"
+    memo = {}
+
+    def cast_node(x, dtype):
+        return _make_op_node("cast", [x], {"dtype": dtype})
+
+    def rebuild(node):
+        from .symbol.symbol import _INT_DATA_OPS
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.kind == "var":
+            out = node
+        else:
+            new_inputs = []
+            want = "float32" if node.op in FP32_OPS else dt
+            for i, x in enumerate(node.inputs):
+                if isinstance(x, Symbol):
+                    x = rebuild(x)
+                    skip = (i == 0 and node.op in _INT_DATA_OPS)
+                    if node.kind == "op" and x.kind != "slice" and not skip:
+                        x = cast_node(x, want)
+                new_inputs.append(x)
+            out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
+                         new_inputs, node.index)
+            out._attr_map = dict(node._attr_map)
+        memo[id(node)] = out
+        return out
+
+    heads = [cast_node(rebuild(h), "float32") for h in sym._heads()]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", **kwargs):
+    """Cast a Gluon block's parameters to the target dtype in place and
+    return it (the TPU bf16 policy: params + activations low precision,
+    normalization stats f32 — handled inside the ops)."""
+    dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else \
+        "float16"
+    for name, p in block.collect_params().items():
+        if "moving" in name or "running" in name:
+            continue  # BN statistics stay f32
+        if _np.dtype(p.dtype) == _np.float32:
+            p.cast(dt)
+    return block

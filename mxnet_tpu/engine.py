@@ -1,0 +1,69 @@
+"""``mx.engine`` — execution-control facade.
+
+Reference: src/engine/ ThreadedEngine (async dependency scheduler with
+read/write var queues, bulking, MXNET_ENGINE_TYPE selection,
+src/engine/engine.cc:32-41) and python/mxnet/engine.py (bulk context
+manager, set_bulk_size).
+
+TPU-native: jax's async dispatch + XLA scheduling *is* the engine — ops
+return futures (jax.Array) immediately and order is data-dependence, exactly
+the property the var-queue engine enforced by hand.  What remains meaningful
+here:
+  * bulking — jit fuses whole programs, so set_bulk_size is a no-op knob
+    kept for script parity;
+  * NaiveEngine — a determinism/debug mode that forces synchronous execution
+    after every op (the MXNET_ENGINE_TYPE=NaiveEngine analog) to bisect
+    async-error delivery, implemented by blocking on every op result.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["bulk", "set_bulk_size", "engine_type", "set_engine_type",
+           "naive_engine_enabled"]
+
+_BULK_SIZE = [int(os.environ.get("MXNET_ENGINE_BULK_SIZE", 15))]
+_ENGINE_TYPE = [os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")]
+
+
+def set_bulk_size(size):
+    """Kept for parity (reference: MXEngineSetBulkSize); XLA fusion makes
+    explicit bulking unnecessary."""
+    prev = _BULK_SIZE[0]
+    _BULK_SIZE[0] = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def engine_type():
+    return _ENGINE_TYPE[0]
+
+
+def set_engine_type(name):
+    """'NaiveEngine' => synchronous per-op execution for debugging
+    (reference: src/engine/engine.cc:32-41 selection)."""
+    assert name in ("NaiveEngine", "ThreadedEngine",
+                    "ThreadedEnginePerDevice")
+    _ENGINE_TYPE[0] = name
+
+
+def naive_engine_enabled():
+    return _ENGINE_TYPE[0] == "NaiveEngine"
+
+
+def maybe_sync(arrays):
+    """Block until `arrays` are computed when NaiveEngine is selected —
+    called by the op dispatcher so every op completes synchronously, the
+    debugging property MXNET_ENGINE_TYPE=NaiveEngine provided."""
+    if naive_engine_enabled():
+        import jax
+        jax.block_until_ready(arrays)

@@ -1,0 +1,2 @@
+from .image import *  # noqa: F401,F403
+from .image import __all__  # noqa: F401

@@ -1,0 +1,464 @@
+"""``mx.image`` — imperative image loading/augmentation + ImageIter.
+
+Reference: python/mxnet/image/image.py (imdecode/imresize/augmenters/
+`ImageIter` over .rec or .lst files) and the native augmenter chain
+(src/io/image_aug_default.cc).
+
+TPU-native re-design: decode/augment run on the host in NumPy/PIL (the chip
+never decodes JPEGs); per-image randomness uses numpy RNG; batches leave the
+host already in final layout so the device sees one contiguous H2D transfer.
+Heavy batch math (normalize/crop of a whole batch) can run as jax ops via the
+regular nd namespace.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray.ndarray import NDArray, _wrap
+import jax.numpy as jnp
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize",
+           "random_size_crop", "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "ResizeAug", "ForceResizeAug", "CenterCropAug", "RandomCropAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "LightingAug", "ColorJitterAug", "RandomOrderAug", "Augmenter",
+           "CreateAugmenter", "ImageIter", "scale_down"]
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return _np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode jpeg/png bytes to an HWC uint8 NDArray (reference:
+    mx.image.imdecode over cv2; PIL here)."""
+    from ..recordio import _decode_img
+    arr = _decode_img(bytes(buf), 1 if flag else 0)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return _wrap(jnp.asarray(arr))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from PIL import Image
+    arr = _to_np(src).astype(_np.uint8)
+    mode = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC}.get(
+        interp, Image.BILINEAR)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = Image.fromarray(arr[:, :, 0] if squeeze else arr)
+    out = _np.asarray(pil.resize((w, h), mode))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _wrap(jnp.asarray(out))
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(arr, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(arr, size[0], size[1], interp)
+    return _wrap(jnp.asarray(arr))
+
+
+def center_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(arr, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    return fixed_crop(arr, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (float, int)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * aspect)))
+        new_h = int(round(_np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            return fixed_crop(arr, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(arr, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _to_np(src).astype(_np.float32)
+    arr -= _np.asarray(mean, _np.float32)
+    if std is not None:
+        arr /= _np.asarray(std, _np.float32)
+    return _wrap(jnp.asarray(arr))
+
+
+# ----------------------------------------------------------------- augmenters
+
+class Augmenter:
+    """Base augmenter (reference: mx.image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _wrap(jnp.asarray(_to_np(src)[:, ::-1].copy()))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _wrap(jnp.asarray(_to_np(src).astype(self.typ)))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return _wrap(jnp.asarray(_to_np(src).astype(_np.float32) * alpha))
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True)
+        mean = gray.mean() * (1.0 - alpha)
+        return _wrap(jnp.asarray(arr * alpha + mean))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return _wrap(jnp.asarray(arr * alpha + gray))
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return _wrap(jnp.asarray(_to_np(src).astype(_np.float32) + rgb))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = [a for a in (
+            BrightnessJitterAug(brightness) if brightness else None,
+            ContrastJitterAug(contrast) if contrast else None,
+            SaturationJitterAug(saturation) if saturation else None)
+            if a is not None]
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Standard augmenter chain factory (reference: mx.image.CreateAugmenter
+    / image_aug_default.cc defaults)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(type("RandSizeCrop", (Augmenter,), {
+            "__call__": lambda self, src: random_size_crop(
+                src, crop_size, (0.08, 1.0), (3 / 4., 4 / 3.),
+                inter_method)[0]})())
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec (RecordIO) or .lst + image dir (reference:
+    mx.image.ImageIter / src/io/iter_image_recordio_2.cc ImageRecordIter).
+
+    Output layout NCHW float32, label float32.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imgrec=None, data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imgrec
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        if aug_list is not None:
+            self.auglist = aug_list
+        else:
+            import inspect
+            aug_params = set(
+                inspect.signature(CreateAugmenter).parameters) - {
+                    "data_shape"}
+            unknown = set(kwargs) - aug_params
+            if unknown:
+                raise TypeError("ImageIter: unknown arguments %s"
+                                % (sorted(unknown),))
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self._records = None
+        self._imglist = None
+        if path_imgrec or imgrec is not None:
+            from .recordio_compat import open_indexed
+            self._rec = imgrec if imgrec is not None else \
+                open_indexed(path_imgrec)
+            self._keys = list(self._rec.keys)
+        else:
+            self._imglist = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    labels = _np.asarray(parts[1:-1], _np.float32)
+                    self._imglist.append((parts[-1], labels))
+            self._keys = list(range(len(self._imglist)))
+        # multi-host sharding: each part reads a disjoint key range
+        # (reference: ImageRecordIter part_index/num_parts)
+        n = len(self._keys)
+        lo = n * part_index // num_parts
+        hi = n * (part_index + 1) // num_parts
+        self._keys = self._keys[lo:hi]
+        self.path_root = path_root
+        self._order = list(range(len(self._keys)))
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shp)]
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self._order)
+        self.cur = 0
+
+    def _read_sample(self, i):
+        from .recordio_compat import record_to_image
+        key = self._keys[self._order[i]]
+        if self._imglist is not None:
+            fname, label = self._imglist[key]
+            img = imread(os.path.join(self.path_root, fname))
+        else:
+            label, img = record_to_image(self._rec.read_idx(key))
+        for aug in self.auglist:
+            img = aug(img)
+        arr = _to_np(img).astype(_np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        return arr, _np.atleast_1d(_np.asarray(label, _np.float32))
+
+    def next(self):
+        n = len(self._keys)
+        if self.cur >= n:
+            raise StopIteration
+        C, H, W = self.data_shape
+        batch_data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width),
+                                _np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            if self.cur >= n:
+                pad = self.batch_size - i
+                for j in range(i, self.batch_size):  # wrap-pad
+                    d, l = self._read_sample(j % max(i, 1))
+                    batch_data[j] = d
+                    batch_label[j] = l[:self.label_width]
+                break
+            d, l = self._read_sample(self.cur)
+            batch_data[i] = d
+            batch_label[i] = l[:self.label_width]
+            self.cur += 1
+            i += 1
+        label = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch([_wrap(jnp.asarray(batch_data))],
+                         [_wrap(jnp.asarray(label))], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

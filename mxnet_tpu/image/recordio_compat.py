@@ -1,0 +1,27 @@
+"""Bridge between mx.image iterators and the RecordIO container.
+
+Reference: the native ImageRecordIOParser2 (src/io/iter_image_recordio_2.cc)
+parses records and decodes images inside the C++ pipeline; here the split is
+recordio.py (framing) + this module (record -> labeled image).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..recordio import MXIndexedRecordIO, unpack, _decode_img  # noqa: F401
+
+
+def open_indexed(path_imgrec):
+    idx_path = path_imgrec[:-4] + ".idx" if path_imgrec.endswith(".rec") \
+        else path_imgrec + ".idx"
+    return MXIndexedRecordIO(idx_path, path_imgrec, "r")
+
+
+def record_to_image(buf):
+    """record bytes -> (label array, HWC uint8 image array)."""
+    header, payload = unpack(buf)
+    label = header.label
+    img = _decode_img(payload)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return _np.atleast_1d(_np.asarray(label, _np.float32)), img

@@ -1,0 +1,67 @@
+"""``mx.monitor`` — tap intermediate outputs/weights during training.
+
+Reference: python/mxnet/monitor.py `Monitor` — installs an executor monitor
+callback (graph_executor.cc:1410 monitor_callback_), collects per-tensor
+stats every `interval` batches, printed via `toc_print`.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as _np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return _np.abs(x.asnumpy()).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        exe.set_monitor_callback(self._stat_helper)
+        self.exes.append(exe)
+
+    def _stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        # stats collect on forward via the installed executor callback
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,167 @@
+"""``mx.np`` — NumPy-compatible array API.
+
+Reference: python/mxnet/numpy/multiarray.py (262 op defs re-implementing
+NumPy semantics over the MXNet engine, dispatched via
+numpy_dispatch_protocol.py).
+
+TPU-native re-design: jax.numpy *is* a NumPy-compatible array API compiled
+to XLA, so this namespace delegates by name to jnp — every function unwraps
+NDArray arguments, runs the jnp twin, and re-wraps, taping a vjp when
+autograd is recording (same mechanism as mx.nd, one lowering per op).  This
+keeps the full mx.np surface (everything jnp implements) without 9k lines of
+per-op shims.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _wrap
+from ..ops.registry import Operator, apply_op
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "eye", "linspace", "newaxis", "pi", "e", "inf", "nan",
+           "float32", "float64", "float16", "bfloat16", "int32", "int64",
+           "int8", "uint8", "bool_", "save", "load", "get_include"]
+
+ndarray = NDArray
+
+newaxis = None
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int32 = _onp.int32
+int64 = _onp.int64
+int8 = _onp.int8
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+try:
+    import ml_dtypes as _ml
+    bfloat16 = _ml.bfloat16
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+
+def array(obj, dtype=None, ctx=None, device=None):
+    if isinstance(obj, NDArray):
+        obj = obj._data
+    return _wrap(jnp.asarray(obj, dtype=dtype))
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    return _wrap(jnp.zeros(shape, dtype or _onp.float32))
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    return _wrap(jnp.ones(shape, dtype or _onp.float32))
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):
+    return _wrap(jnp.zeros(shape, dtype or _onp.float32))
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, device=None):
+    return _wrap(jnp.full(shape, fill_value, dtype))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return _wrap(jnp.arange(start, stop, step, dtype))
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return _wrap(jnp.eye(N, M, k, dtype or _onp.float32))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=dtype, axis=axis)
+    if retstep:
+        return _wrap(out[0]), out[1]
+    return _wrap(out)
+
+
+def save(file, arr):
+    from ..ndarray.ndarray import save as nd_save
+    nd_save(file, arr)
+
+
+def load(file):
+    from ..ndarray.ndarray import load as nd_load
+    return nd_load(file)
+
+
+def get_include():
+    return _onp.get_include()
+
+
+# Ops whose outputs are not differentiable — generic delegation must not
+# tape a vjp through them (integer/bool outputs break jax.vjp).
+_NONDIFF = {"argmax", "argmin", "argsort", "argwhere", "nonzero", "sign",
+            "floor", "ceil", "round", "rint", "trunc", "fix", "equal",
+            "not_equal", "less", "less_equal", "greater", "greater_equal",
+            "logical_and", "logical_or", "logical_xor", "logical_not",
+            "isnan", "isinf", "isfinite", "isclose", "array_equal",
+            "searchsorted", "digitize", "count_nonzero", "unique",
+            "result_type", "shape", "ndim", "size", "iinfo", "finfo",
+            "can_cast", "issubdtype", "dtype"}
+
+_PASSTHROUGH = {"result_type", "iinfo", "finfo", "can_cast", "issubdtype",
+                "dtype", "broadcast_shapes"}
+
+_SEQ_APIS = {"stack", "concatenate", "vstack", "hstack", "dstack",
+             "column_stack", "row_stack"}
+
+_CACHE = {}
+
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    if name in _CACHE:
+        return _CACHE[name]
+    target = getattr(jnp, name, None)
+    if target is None:
+        raise AttributeError("mx.np has no attribute %r" % (name,)) from None
+    if not callable(target) or isinstance(target, type):
+        _CACHE[name] = target
+        return target
+    if name in _PASSTHROUGH:
+        _CACHE[name] = target
+        return target
+
+    if name in _SEQ_APIS:
+        # sequence-of-arrays API: unpack through apply_op so each element
+        # is taped, repack for the jnp call
+        op = Operator("np." + name,
+                      lambda *arrs, **kw: target(list(arrs), **kw),
+                      differentiable=True)
+
+        def fn(seq, *rest, **kwargs):
+            if rest:
+                kwargs.setdefault("axis", rest[0])
+            kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                      for k, v in kwargs.items()}
+            return apply_op(op, *seq, **kwargs)
+    else:
+        op = Operator("np." + name,
+                      lambda *a, **kw: target(*a, **kw),
+                      differentiable=name not in _NONDIFF)
+
+        def fn(*args, **kwargs):
+            # positional NDArrays stay wrapped so apply_op tapes them for
+            # autograd; keyword values (axis=, where=...) are attrs
+            kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                      for k, v in kwargs.items()}
+            return apply_op(op, *args, **kwargs)
+
+    fn.__name__ = name
+    fn.__qualname__ = "mx.np." + name
+    _CACHE[name] = fn
+    return fn

@@ -1,0 +1,68 @@
+"""``mx.npx`` — numpy-extension namespace.
+
+Reference: python/mxnet/numpy_extension/ (`_npx` ops: the neural-network ops
+usable on np-style arrays, plus np-mode switches `set_np`/`reset_np`).
+Here every registered framework op (FullyConnected, Convolution, softmax...)
+is reachable on np arrays through the shared registry — same dispatch as
+mx.nd, so np-mode does not change numerics.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "set_np_shape",
+           "use_np", "use_np_array", "use_np_shape"]
+
+_NP_MODE = {"array": False, "shape": False}
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Enable numpy semantics globally (reference: mx.npx.set_np).  The
+    TPU core is already numpy-semantic (jax), so this only flips the flags
+    queried by is_np_array/is_np_shape."""
+    _NP_MODE["array"] = bool(array)
+    _NP_MODE["shape"] = bool(shape)
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def is_np_array():
+    return _NP_MODE["array"]
+
+
+def is_np_shape():
+    return _NP_MODE["shape"]
+
+
+def set_np_shape(active):
+    prev = _NP_MODE["shape"]
+    _NP_MODE["shape"] = bool(active)
+    return prev
+
+
+def use_np(func):
+    """Decorator parity shim — numpy semantics are always on in this
+    framework, so the function is returned unchanged."""
+    return func
+
+
+use_np_array = use_np
+use_np_shape = use_np
+
+
+def __getattr__(name):
+    try:
+        op = _registry.get(name)
+    except AttributeError:
+        raise AttributeError(
+            "module 'npx' has no attribute %r" % (name,)) from None
+
+    def fn(*args, **kwargs):
+        kwargs.pop("out", None)
+        return _registry.apply_op(op, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn

@@ -110,6 +110,9 @@ def apply_op(op, *inputs, **attrs):
 
         diff_in = [in_arrays[i] for i in nd_idx]
         out_vals, vjp = jax.vjp(pure, *diff_in)
+        from ..engine import naive_engine_enabled
+        if naive_engine_enabled():
+            jax.block_until_ready(out_vals)
         multi = isinstance(out_vals, (tuple, list))
         outs = [_wrap(v) for v in (out_vals if multi else (out_vals,))]
 
@@ -122,6 +125,10 @@ def apply_op(op, *inputs, **attrs):
         return outs if multi else outs[0]
 
     out_vals = op.fn(*in_arrays, **attrs)
+    from ..engine import naive_engine_enabled
+    if naive_engine_enabled():
+        # NaiveEngine debug mode: synchronous per-op completion
+        jax.block_until_ready(out_vals)
     if isinstance(out_vals, (tuple, list)):
         return [_wrap(v) for v in out_vals]
     return _wrap(out_vals)

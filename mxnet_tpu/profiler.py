@@ -1,0 +1,220 @@
+"""``mx.profiler`` — profiling facade.
+
+Reference: src/profiler/profiler.h:251 (per-thread event buffers, Chrome
+tracing JSON dump via DumpProfile, aggregate per-op stats) + python frontend
+python/mxnet/profiler.py (set_config/start/stop/dumps, scoped
+Domain/Task/Frame/Counter/Marker APIs).
+
+TPU-native: jax.profiler writes XPlane/TensorBoard traces (the Chrome-trace
+analog, viewable in TensorBoard/Perfetto); `jax.profiler.TraceAnnotation`
+replaces scoped tasks; the aggregate per-op table (`dumps(format='table')`)
+is synthesized from our own host-side event records to preserve the
+`mx.profiler` UX.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "set_state", "Domain", "Task", "Frame", "Event", "Counter",
+           "Marker", "scope", "profiler_scope"]
+
+_CONFIG = {"profile_all": False, "filename": "profile.json",
+           "aggregate_stats": True}
+_STATE = {"running": False, "trace_dir": None, "t0": None}
+_EVENTS = []
+_EVENTS_LOCK = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Accepts the reference's knobs (profile_symbolic, profile_imperative,
+    profile_memory, profile_api, aggregate_stats, filename...); the ones
+    meaningful on TPU map to the jax trace dir + host event table."""
+    _CONFIG.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    import jax
+    if _STATE["running"]:
+        return
+    trace_dir = _CONFIG.get("trace_dir") or os.path.splitext(
+        _CONFIG["filename"])[0] + "_xplane"
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _STATE["trace_dir"] = trace_dir
+    except Exception:
+        _STATE["trace_dir"] = None  # device tracing unavailable: host only
+    _STATE["running"] = True
+    _STATE["t0"] = time.perf_counter()
+
+
+def stop(profile_process="worker"):
+    import jax
+    if not _STATE["running"]:
+        return
+    if _STATE["trace_dir"] is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    _STATE["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def _record(kind, name, t_start, t_end, args=None):
+    with _EVENTS_LOCK:
+        _EVENTS.append({"kind": kind, "name": name, "ts": t_start,
+                        "dur": t_end - t_start, "args": args or {}})
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write host-side events as Chrome tracing JSON next to the XPlane dir
+    (reference: DumpProfile, src/profiler/profiler.h:299)."""
+    with _EVENTS_LOCK:
+        events = list(_EVENTS)
+    trace = {"traceEvents": [
+        {"name": e["name"], "cat": e["kind"], "ph": "X",
+         "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6, "pid": 0, "tid": 0,
+         "args": e["args"]} for e in events]}
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump(trace, f)
+    return _CONFIG["filename"]
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate per-name stats table (reference: aggregate_stats.cc)."""
+    with _EVENTS_LOCK:
+        events = list(_EVENTS)
+        if reset:
+            _EVENTS.clear()
+    agg = {}
+    for e in events:
+        s = agg.setdefault(e["name"], {"count": 0, "total": 0.0,
+                                       "min": float("inf"), "max": 0.0})
+        s["count"] += 1
+        s["total"] += e["dur"]
+        s["min"] = min(s["min"], e["dur"])
+        s["max"] = max(s["max"], e["dur"])
+    rows = sorted(agg.items(), key=lambda kv: kv[1][sort_by],
+                  reverse=not ascending)
+    lines = ["%-40s %8s %12s %12s %12s" % ("Name", "Calls", "Total(ms)",
+                                           "Min(ms)", "Max(ms)")]
+    for name, s in rows:
+        lines.append("%-40s %8d %12.3f %12.3f %12.3f"
+                     % (name[:40], s["count"], s["total"] * 1e3,
+                        s["min"] * 1e3, s["max"] * 1e3))
+    return "\n".join(lines)
+
+
+class Domain:
+    """Named grouping (reference: profiler.py Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Scoped:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = "%s::%s" % (domain.name, name) if domain else name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            _record(self.__class__.__name__.lower(), self.name, self._t0,
+                    time.perf_counter())
+            self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scoped):
+    pass
+
+
+class Frame(_Scoped):
+    pass
+
+
+class Event(_Scoped):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.name = "%s::%s" % (domain.name, name) if domain else name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+        t = time.perf_counter()
+        _record("counter", self.name, t, t, {"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.name = "%s::%s" % (domain.name, name) if domain else name
+
+    def mark(self, scope="process"):
+        t = time.perf_counter()
+        _record("marker", self.name, t, t)
+
+
+class scope(_Scoped):
+    """`with mx.profiler.scope('fwd'):` convenience."""
+
+    def __init__(self, name="mxnet_tpu"):
+        super().__init__(None, name)
+
+
+profiler_scope = scope

@@ -1,0 +1,83 @@
+"""``mx.runtime`` — runtime feature introspection.
+
+Reference: python/mxnet/runtime.py `Features`/`feature_list` over the libinfo
+build flags (include/mxnet/libinfo.h:141-193 — CUDA, CUDNN, MKLDNN,
+DIST_KVSTORE...).  TPU-native: features reflect what this build can actually
+do (platform backends, pallas availability, distributed init), discovered at
+query time instead of baked at compile time.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+__all__ = ["Feature", "Features", "feature_list", "is_enabled"]
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    import jax
+    feats = {}
+
+    def have(mod):
+        try:
+            __import__(mod)
+            return True
+        except Exception:
+            return False
+
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        platforms = set()
+    feats["TPU"] = "tpu" in platforms
+    feats["CPU"] = True
+    feats["GPU"] = "gpu" in platforms or "cuda" in platforms
+    feats["PALLAS"] = have("jax.experimental.pallas")
+    feats["DIST_KVSTORE"] = True          # jax.distributed-backed
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["SIGNAL_HANDLER"] = True
+    feats["OPENCV"] = False               # PIL-based image path
+    feats["PIL"] = have("PIL")
+    feats["BLAS_OPEN"] = False            # XLA supplies all kernels
+    feats["MKLDNN"] = False
+    feats["CUDA"] = False
+    feats["CUDNN"] = False
+    feats["NATIVE_IO"] = _native_io_available()
+    return feats
+
+
+def _native_io_available():
+    try:
+        from .native import lib as _native  # noqa: F401
+        return _native.available()
+    except Exception:
+        return False
+
+
+class Features(dict):
+    """Mapping name -> Feature (reference Features mapping API)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(
+            "✔ %s" % k if v.enabled else "✖ %s" % k
+            for k, v in sorted(self.items()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature %r does not exist" % (feature_name,))
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())
+
+
+def is_enabled(feature_name):
+    return Features().is_enabled(feature_name)

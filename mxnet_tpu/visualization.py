@@ -1,0 +1,102 @@
+"""``mx.viz`` — network visualization.
+
+Reference: python/mxnet/visualization.py — `plot_network` (graphviz render of
+a Symbol) and `print_summary` (layer table with shapes/params).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["plot_network", "print_summary"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-by-layer table (reference: visualization.py print_summary)."""
+    from .symbol.symbol import _topo
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    arg_shapes = {}
+    out_shapes_map = {}
+    if shape:
+        arg_sh, _, aux_sh = symbol.infer_shape(**shape)
+        arg_shapes = dict(zip(symbol.list_arguments(), arg_sh))
+        arg_shapes.update(zip(symbol.list_auxiliary_states(), aux_sh))
+        from .symbol.symbol import _infer_shapes_partial
+        var_shapes, node_shapes = _infer_shapes_partial(
+            symbol, {k: v for k, v in shape.items()})
+        out_shapes_map = node_shapes
+
+    def prow(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos].ljust(pos)
+        print(line)
+
+    print("=" * line_length)
+    prow(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print("=" * line_length)
+    total = 0
+    for node in _topo(symbol):
+        if node.kind != "op":
+            continue
+        oshape = out_shapes_map.get((id(node), 0), "")
+        nparams = 0
+        prev = []
+        for x in node.inputs:
+            if x is None or not hasattr(x, "kind"):
+                continue
+            if x.kind == "var" and x.name in arg_shapes \
+                    and x.name not in (shape or {}):
+                # user-supplied inputs (data/label) are not parameters
+                shp = arg_shapes.get(x.name)
+                if shp:
+                    nparams += int(_np.prod(shp))
+            elif x.kind != "var":
+                prev.append(x.name)
+        total += nparams
+        prow(["%s (%s)" % (node.name, node.op), oshape, nparams,
+              ",".join(prev)])
+    print("=" * line_length)
+    print("Total params: %d" % total)
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz Digraph of the Symbol DAG (requires python-graphviz; raises
+    ImportError otherwise, matching the reference's optional dep)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires the graphviz package") from e
+    from .symbol.symbol import _topo
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title)
+    attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    attrs.update(node_attrs)
+    palette = {"FullyConnected": "#fb8072", "Convolution": "#fb8072",
+               "BatchNorm": "#bebada", "Activation": "#ffffb3",
+               "Pooling": "#80b1d3", "softmax": "#fccde5"}
+    for node in _topo(symbol):
+        if node.kind == "var":
+            if hide_weights and node.name != "data" and \
+                    not node.name.endswith("label"):
+                continue
+            dot.node(node.name, node.name, shape="oval", style="filled",
+                     fillcolor="#8dd3c7")
+        elif node.kind == "op":
+            color = palette.get(node.op, "#b3de69")
+            dot.node(node.name, "%s\n%s" % (node.name, node.op),
+                     fillcolor=color, **attrs)
+            for x in node.inputs:
+                if x is None or not hasattr(x, "kind"):
+                    continue
+                src = x.inputs[0] if x.kind == "slice" else x
+                if src.kind == "var" and hide_weights and \
+                        src.name != "data" and \
+                        not src.name.endswith("label"):
+                    continue
+                dot.edge(src.name, node.name)
+    return dot

@@ -1,0 +1,183 @@
+"""RecordIO / image / profiler / runtime tests (reference analog:
+tests/python/unittest/test_recordio.py, test_image.py, test_profiler.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = mx.recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"payload-%d" % i)
+    w.close()
+    r = mx.recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        b = r.read()
+        if b is None:
+            break
+        got.append(b)
+    assert got == [b"payload-%d" % i for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, b"rec-%d" % i)
+    w.close()
+    r = mx.recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"rec-7"
+    assert r.read_idx(2) == b"rec-2"
+
+
+def test_pack_unpack_header():
+    h = mx.recordio.IRHeader(0, 3.0, 42, 0)
+    s = mx.recordio.pack(h, b"hello")
+    h2, payload = mx.recordio.unpack(s)
+    assert payload == b"hello"
+    assert h2.label == 3.0 and h2.id == 42
+    # multi-label
+    h = mx.recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = mx.recordio.pack(h, b"xyz")
+    h2, payload = mx.recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"xyz"
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = (np.random.RandomState(0).uniform(0, 255, (16, 16, 3))
+           .astype(np.uint8))
+    s = mx.recordio.pack_img(mx.recordio.IRHeader(0, 1.0, 0, 0), img,
+                             img_fmt=".png")
+    h, img2 = mx.recordio.unpack_img(s)
+    assert h.label == 1.0
+    np.testing.assert_array_equal(img2, img)  # png is lossless
+
+
+def _make_rec_dataset(tmp_path, n=12, size=24):
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.uniform(0, 255, (size, size, 3)).astype(np.uint8)
+        buf = mx.recordio.pack_img(
+            mx.recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png")
+        w.write_idx(i, buf)
+    w.close()
+    return rec
+
+
+def test_imageiter_from_rec(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    assert batch.label[0].shape == (4,)
+    n = 1 + sum(1 for _ in it)
+    assert n == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_imageiter_sharding(tmp_path):
+    """part_index/num_parts reads disjoint shards (reference:
+    ImageRecordIter distributed loading)."""
+    rec = _make_rec_dataset(tmp_path)
+    labels = []
+    for part in range(3):
+        it = mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                                path_imgrec=rec, part_index=part,
+                                num_parts=3)
+        for b in it:
+            labels.extend(np.asarray(b.label[0].asnumpy()).tolist())
+    assert len(labels) == 12
+
+
+def test_augmenters():
+    img = np.random.RandomState(0).uniform(0, 255, (32, 24, 3)) \
+        .astype(np.uint8)
+    out = mx.image.resize_short(img, 16)
+    assert min(out.shape[:2]) == 16
+    crop, _ = mx.image.center_crop(img, (10, 12))
+    assert crop.shape[:2] == (12, 10)
+    flipped = mx.image.HorizontalFlipAug(1.0)(mx.nd.array(img))
+    np.testing.assert_array_equal(flipped.asnumpy(), img[:, ::-1])
+    norm = mx.image.color_normalize(img, mean=(1.0, 2.0, 3.0),
+                                    std=(2.0, 2.0, 2.0))
+    np.testing.assert_allclose(
+        norm.asnumpy(), (img.astype(np.float32) - [1, 2, 3]) / 2, rtol=1e-6)
+    chain = mx.image.CreateAugmenter((3, 16, 16), rand_crop=True,
+                                     rand_mirror=True, mean=True, std=True)
+    out = mx.nd.array(img)
+    for aug in chain:
+        out = aug(out)
+    assert out.shape[:2] == (16, 16)
+
+
+def test_profiler_scope_and_dumps(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"),
+                           trace_dir=None)
+    with mx.profiler.scope("unit_scope"):
+        _ = mx.nd.ones((4, 4)).sum().asnumpy()
+    table = mx.profiler.dumps()
+    assert "unit_scope" in table
+    path = mx.profiler.dump()
+    import json
+    with open(path) as f:
+        data = json.load(f)
+    assert any("unit_scope" in e["name"] for e in data["traceEvents"])
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert "PIL" in feats
+    assert repr(feats)
+
+
+def test_engine_bulk_parity():
+    assert mx.engine.set_bulk_size(10) >= 0
+    with mx.engine.bulk(5):
+        pass
+    mx.engine.set_engine_type("NaiveEngine")
+    assert mx.engine.naive_engine_enabled()
+    mx.engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_recordio_continuation_records(tmp_path, monkeypatch):
+    """Oversize payloads split into dmlc continuation parts and reassemble."""
+    import mxnet_tpu.recordio as rio
+    monkeypatch.setattr(rio, "_LENGTH_MASK", 63)  # 2^k-1: force splitting
+    path = str(tmp_path / "big.rec")
+    payload = bytes(range(256)) * 2   # 512 bytes >> 64
+    w = rio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.write(b"small")
+    w.close()
+    r = rio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    assert r.read() == b"small"
+    assert r.read() is None
+
+
+def test_naive_engine_sync_mode():
+    mx.engine.set_engine_type("NaiveEngine")
+    try:
+        out = mx.nd.ones((8, 8)).sum()
+        assert float(out.asnumpy()) == 64.0
+    finally:
+        mx.engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_imageiter_rejects_unknown_kwargs(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    with pytest.raises(TypeError):
+        mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                           path_imgrec=rec, rand_cropp=True)
