@@ -51,6 +51,8 @@ _LAZY = {
     "recordio": ".recordio",
     "engine": ".engine",
     "monitor": ".monitor",
+    "operator": ".operator",
+    "native": ".native",
     "contrib": ".contrib",
 }
 

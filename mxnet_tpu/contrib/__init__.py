@@ -1,0 +1,12 @@
+"""``mx.contrib`` — experimental/auxiliary subsystems.
+
+Reference: python/mxnet/contrib/ (AMP, quantization driver, ONNX, TensorRT,
+text, tensorboard, SVRG).  Here: quantization (INT8 PTQ with calibration) is
+first-class; amp lives at mx.amp (TPU bf16 policy); accelerator-specific
+inference engines (TensorRT) have no TPU counterpart — XLA is the inference
+engine.
+"""
+from . import quantization  # noqa: F401
+from .. import amp  # noqa: F401  (mx.contrib.amp parity alias)
+
+__all__ = ["quantization", "amp"]

@@ -1,0 +1,199 @@
+"""INT8 post-training quantization.
+
+Reference: python/mxnet/contrib/quantization.py `quantize_model` (calib_mode
+'naive' min/max or 'entropy' KL, :443-576) driving the C++ graph pass
+(src/operator/quantization/quantize_graph_pass.cc) + calibrate.cc (KL
+histogram) + int8 kernels.
+
+TPU-native re-design: quantization is *simulated-affine* (AQT-style):
+tensors carry f32 values quantized to int8 grid (scale per tensor) so the
+MXU's native bf16/int8 matmuls get the same numerics XLA would emit for
+int8, while every op stays a pure jax function.  The graph pass inserts
+quantize/dequantize around compute ops, thresholds come from naive min/max
+or KL-divergence calibration over a calibration iterator — the same three
+calib modes and workflow as the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..ops.registry import register as _register_op
+
+__all__ = ["quantize_model", "calib_thresholds", "quantize", "dequantize",
+           "QUANTIZABLE_OPS"]
+
+
+# primitive quantize/dequantize/_sim_quant ops live in ops/contrib.py so
+# they register with every registry consumer (nd/sym/np) at package import.
+
+def quantize(x, amax):
+    """f32 -> (int8 grid simulated in f32).  Symmetric per-tensor."""
+    scale = 127.0 / max(float(amax), 1e-12)
+    return jnp.clip(jnp.round(jnp.asarray(x) * scale), -127, 127) / scale
+
+
+def dequantize(q, amax):
+    return q  # simulated-affine: values already on the f32 grid
+
+
+# --------------------------------------------------------------- calibration
+
+def _kl_threshold(hist, edges, num_quantized_bins=255):
+    """KL-divergence threshold search (reference: calibrate.cc entropy
+    mode): pick the clip range minimizing KL(P||Q) between the f32
+    histogram P and its int8-requantized image Q."""
+    hist = hist.astype(_np.float64)
+    n = len(hist)
+    best_kl, best_t = _np.inf, edges[-1]
+    # scan candidate clip points from 1/8 of the range up
+    for i in range(num_quantized_bins // 2, n + 1, max(1, n // 64)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip mass into the edge bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = max(int(_np.floor((j + 1) * factor)), lo + 1)
+            mass = hist[lo:min(hi, i)].sum()
+            nz = (hist[lo:min(hi, i)] > 0).sum()
+            if nz:
+                q[lo:min(hi, i)] = _np.where(hist[lo:min(hi, i)] > 0,
+                                             mass / nz, 0)
+        p_n = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q_n = q / qs
+        mask = (p_n > 0) & (q_n > 0)
+        kl = _np.sum(p_n[mask] * _np.log(p_n[mask] / q_n[mask]))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = edges[i] if i < len(edges) else edges[-1]
+    return float(best_t)
+
+
+def calib_thresholds(activations, mode="entropy", num_bins=4001):
+    """Per-tensor |max| clip thresholds from collected activations.
+
+    activations: {name: np.ndarray of samples}.  mode: 'naive' (min/max) or
+    'entropy' (KL) — the reference's calib_mode values."""
+    out = {}
+    for name, arr in activations.items():
+        a = _np.abs(_np.asarray(arr).ravel())
+        if mode == "naive" or a.size == 0:
+            out[name] = float(a.max()) if a.size else 1.0
+            continue
+        amax = float(a.max())
+        if amax == 0:
+            out[name] = 1.0
+            continue
+        hist, edges = _np.histogram(a, bins=num_bins, range=(0, amax))
+        kl_t = _kl_threshold(hist, edges)
+        # percentile floor: never clip more than 0.01% of observed mass —
+        # guards small/sensitive models where pure KL over-clips
+        floor = float(_np.percentile(a, 99.99))
+        out[name] = max(kl_t, floor)
+    return out
+
+
+# ---------------------------------------------------------------- graph pass
+
+QUANTIZABLE_OPS = {"FullyConnected", "Convolution"}
+
+
+def _quantize_symbol(sym, thresholds, excluded_names):
+    """Rebuild the DAG inserting simulated int8 quantization on the data and
+    weight inputs of quantizable ops (the quantize_graph_pass.cc analog)."""
+    from ..symbol.symbol import Symbol, Group, _make_op_node
+
+    memo = {}
+
+    def qnode(x, amax):
+        return _make_op_node("_sim_quant", [x], {"amax": float(amax)})
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.kind == "var":
+            out = node
+        else:
+            new_inputs = []
+            quantize_me = node.op in QUANTIZABLE_OPS and \
+                node.name not in excluded_names
+            for i, x in enumerate(node.inputs):
+                if isinstance(x, Symbol):
+                    x = rebuild(x)
+                    if quantize_me and i <= 1:  # data + weight
+                        key = x.name if x.kind == "var" else \
+                            "%s_output" % x.name
+                        amax = thresholds.get(key)
+                        if amax:
+                            x = qnode(x, amax)
+                new_inputs.append(x)
+            out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
+                         new_inputs, node.index)
+            out._attr_map = dict(node._attr_map)
+        memo[id(node)] = out
+        return out
+
+    heads = [rebuild(h) for h in sym._heads()]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=(), calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None, **kwargs):
+    """The reference's one-call PTQ driver (contrib/quantization.py:443):
+    collect activations over calib_data, compute thresholds, return
+    (quantized symbol, params).  With calib_mode='none', only weights get
+    quantized (dynamic activation range at runtime)."""
+    from ..symbol.symbol import _topo
+
+    thresholds = {}
+    # weight thresholds directly from params
+    for name, arr in arg_params.items():
+        a = _np.abs(arr.asnumpy() if hasattr(arr, "asnumpy")
+                    else _np.asarray(arr))
+        thresholds[name] = float(a.max()) if a.size else 1.0
+
+    if calib_mode != "none" and calib_data is not None:
+        # tap every quantizable op's data input by evaluating internals
+        internals = sym.get_internals()
+        want = []
+        for node in _topo(sym):
+            if node.kind == "op" and node.op in QUANTIZABLE_OPS:
+                x = node.inputs[0]
+                if hasattr(x, "kind") and x.kind != "var":
+                    want.append("%s_output" % x.name)
+        want = sorted(set(want))
+        taps = {}
+        seen = 0
+        mod_outputs = [internals[n] for n in want] if want else []
+        if mod_outputs:
+            from ..module import Module
+            from ..symbol.symbol import Group
+            tap_sym = Group(mod_outputs)
+            mod = Module(tap_sym, data_names=data_names, label_names=[])
+            first = next(iter(calib_data))
+            calib_data.reset()
+            mod.bind([(n, tuple(d.shape)) for n, d in
+                      zip(data_names, first.data)], for_training=False)
+            mod.set_params(arg_params, aux_params, allow_missing=True)
+            for batch in calib_data:
+                mod.forward(batch, is_train=False)
+                for name, out in zip(want, mod.get_outputs()):
+                    taps.setdefault(name, []).append(out.asnumpy())
+                seen += batch.data[0].shape[0]
+                if num_calib_examples and seen >= num_calib_examples:
+                    break
+            calib_data.reset()
+        acts = {k: _np.concatenate(v) for k, v in taps.items()}
+        thresholds.update(calib_thresholds(acts, mode=calib_mode))
+
+    qsym = _quantize_symbol(sym, thresholds, set(excluded_sym_names))
+    return qsym, arg_params, aux_params

@@ -344,6 +344,17 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+class _NativeRecAdapter:
+    """Duck-types MXIndexedRecordIO over the C++ mmap reader."""
+
+    def __init__(self, native_file):
+        self._f = native_file
+        self.keys = list(range(len(native_file)))
+
+    def read_idx(self, i):
+        return self._f.read_index(i)
+
+
 class ImageIter(DataIter):
     """Image iterator over .rec (RecordIO) or .lst + image dir (reference:
     mx.image.ImageIter / src/io/iter_image_recordio_2.cc ImageRecordIter).
@@ -378,9 +389,20 @@ class ImageIter(DataIter):
         self._records = None
         self._imglist = None
         if path_imgrec or imgrec is not None:
-            from .recordio_compat import open_indexed
-            self._rec = imgrec if imgrec is not None else \
-                open_indexed(path_imgrec)
+            self._rec = None
+            if imgrec is not None:
+                self._rec = imgrec
+            else:
+                try:  # native mmap reader (src/native/recordio.cc)
+                    from ..native import NativeRecordFile, available
+                    if available():
+                        self._rec = _NativeRecAdapter(
+                            NativeRecordFile(path_imgrec))
+                except Exception:
+                    self._rec = None
+                if self._rec is None:
+                    from .recordio_compat import open_indexed
+                    self._rec = open_indexed(path_imgrec)
             self._keys = list(self._rec.keys)
         else:
             self._imglist = []

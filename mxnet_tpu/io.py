@@ -199,16 +199,28 @@ class CSVIter(DataIter):
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
-        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = self._load_csv(data_csv)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = self._load_csv(label_csv)
             label = label.reshape((-1,) + tuple(label_shape))
         self._inner = NDArrayIter(
             data, label, batch_size,
             last_batch_handle="pad" if round_batch else "discard")
         super().__init__(batch_size)
+
+    @staticmethod
+    def _load_csv(path):
+        try:  # native fast parser (src/native/recordio.cc csv_parse_f32)
+            from .native import csv_parse, available
+            if available():
+                arr = csv_parse(path)
+                if arr is not None:
+                    return arr
+        except Exception:
+            pass
+        return _np.loadtxt(path, delimiter=",", dtype=_np.float32)
 
     @property
     def provide_data(self):

@@ -1,0 +1,11 @@
+"""mxnet_tpu.native — ctypes bindings to the C++ IO runtime (src/native).
+
+Reference analog: the native layers the reference keeps in C++ — dmlc-core
+recordio, the OMP record parser (src/io/iter_image_recordio_2.cc:146) and the
+ThreadedIter prefetcher (src/io/iter_prefetcher.h) — compiled here into
+libmxtpu_native.so.  Pure-Python fallbacks exist everywhere (recordio.py),
+so the native path is an accelerator, not a requirement.
+"""
+from .lib import (available, build, NativeRecordFile, csv_parse)  # noqa: F401
+
+__all__ = ["available", "build", "NativeRecordFile", "csv_parse"]

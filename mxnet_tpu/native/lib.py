@@ -1,0 +1,126 @@
+"""ctypes loader for libmxtpu_native.so, with build-on-first-use."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as _np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libmxtpu_native.so")
+_SRC = os.path.normpath(os.path.join(_DIR, "..", "..", "src", "native"))
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def build(force=False):
+    """Compile src/native with make; returns True on success."""
+    global _build_failed
+    src = os.path.join(_SRC, "recordio.cc")
+    if not os.path.isfile(src):
+        _build_failed = True
+        return False
+    if not force and os.path.isfile(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(["make", "-C", _SRC, "OUT=%s" % _SO],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        _build_failed = True
+        return False
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.isfile(_SO) and not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            if not build(force=True):
+                return None
+            lib = ctypes.CDLL(_SO)
+        lib.rio_open.restype = ctypes.c_void_p
+        lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rio_count.restype = ctypes.c_int64
+        lib.rio_count.argtypes = [ctypes.c_void_p]
+        lib.rio_get.restype = ctypes.c_int
+        lib.rio_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+        lib.csv_parse_f32.restype = ctypes.c_int64
+        lib.csv_parse_f32.argtypes = [ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int64)]
+        lib.rio_abi_version.restype = ctypes.c_int
+        if lib.rio_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+class NativeRecordFile:
+    """Zero-copy random access over a .rec file via the C++ mmap reader."""
+
+    def __init__(self, path, prefetch_window=64):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._h = lib.rio_open(path.encode(), prefetch_window)
+        if not self._h:
+            raise IOError("cannot open/parse record file %s" % (path,))
+
+    def __len__(self):
+        return self._lib.rio_count(self._h)
+
+    def read_index(self, i):
+        """Record i as bytes (copied out of the mmap)."""
+        data = ctypes.POINTER(ctypes.c_ubyte)()
+        length = ctypes.c_uint64()
+        if self._lib.rio_get(self._h, i, ctypes.byref(data),
+                             ctypes.byref(length)) != 0:
+            raise IndexError(i)
+        return ctypes.string_at(data, length.value)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def csv_parse(path, max_vals=1 << 26):
+    """Parse a float CSV natively -> 2-D float32 array, or None if the
+    native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = _np.empty(max_vals, _np.float32)
+    ncols = ctypes.c_int64()
+    rows = lib.csv_parse_f32(
+        path.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_vals, ctypes.byref(ncols))
+    if rows < 0 or ncols.value == 0:
+        return None
+    return buf[:rows * ncols.value].reshape(rows, ncols.value).copy()

@@ -1,0 +1,160 @@
+"""``mx.operator`` — Python-defined custom operators.
+
+Reference: python/mxnet/operator.py — `CustomOp` (forward/backward with
+assign), `CustomOpProp` (shape/type inference + registration), `register`;
+native side runs these on dedicated worker threads outside the engine to
+dodge GIL deadlocks (src/operator/custom/custom-inl.h:52-166).
+
+TPU-native re-design: a custom op is host Python called through
+``jax.pure_callback``, so it composes with jit/vmap of the surrounding
+program (the engine-thread machinery is unnecessary — XLA treats the
+callback as an opaque host node with declared output shapes, which is what
+CustomOpProp.infer_shape provides).  ``backward`` is wired in with
+``jax.custom_vjp``, keeping autograd exact.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import register as _register_op, Operator
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM = {}
+
+
+class CustomOp:
+    """Base class for the imperative kernel (reference: operator.py:428)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """reference semantics: honor the write/add/null request."""
+        if req == "null":
+            return
+        if isinstance(src, NDArray):
+            src = src._data
+        if req == "add":
+            dst._data = dst._data + jnp.asarray(src)
+        else:
+            dst._data = jnp.asarray(src)
+
+
+class CustomOpProp:
+    """Shape/type metadata + kernel factory (reference: operator.py:474)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under `reg_name`; the
+    op becomes reachable as mx.nd.Custom(..., op_type=reg_name) and by name
+    (reference: mx.operator.register)."""
+
+    def deco(prop_cls):
+        _CUSTOM[reg_name] = prop_cls
+
+        def op_fn(*arrays, **attrs):
+            attrs.pop("op_type", None)
+            prop = prop_cls(**attrs)
+            in_shapes = [tuple(a.shape) for a in arrays]
+            in_dtypes = [a.dtype for a in arrays]
+            _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+            _, out_dtypes, _ = prop.infer_type(in_dtypes)
+            out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                              for s, d in zip(out_shapes, out_dtypes))
+            kernel = prop.create_operator(None, in_shapes, in_dtypes)
+
+            def host_forward(*host_arrays):
+                ins = [_wrap(jnp.asarray(a)) for a in host_arrays]
+                outs = [_wrap(jnp.zeros(s.shape, s.dtype))
+                        for s in out_specs]
+                kernel.forward(True, ["write"] * len(outs), ins, outs, [])
+                res = tuple(_np.asarray(o._data) for o in outs)
+                return res if len(res) > 1 else res[0]
+
+            def host_backward(host_in, host_out, host_ograds):
+                ins = [_wrap(jnp.asarray(a)) for a in host_in]
+                outs = [_wrap(jnp.asarray(a)) for a in host_out]
+                ogs = [_wrap(jnp.asarray(a)) for a in host_ograds]
+                igs = [_wrap(jnp.zeros_like(jnp.asarray(a)))
+                       for a in host_in]
+                kernel.backward(["write"] * len(igs), ogs, ins, outs, igs,
+                                [])
+                res = tuple(_np.asarray(g._data) for g in igs)
+                return res if len(res) > 1 else res[0]
+
+            single_out = len(out_specs) == 1
+
+            @jax.custom_vjp
+            def call(*xs):
+                out = jax.pure_callback(
+                    host_forward,
+                    out_specs[0] if single_out else out_specs, *xs)
+                return out
+
+            def call_fwd(*xs):
+                out = call(*xs)
+                return out, (xs, out)
+
+            def call_bwd(res, ct):
+                xs, out = res
+                outs = (out,) if single_out else tuple(out)
+                cts = (ct,) if single_out else tuple(ct)
+                in_specs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                                 for x in xs)
+                grads = jax.pure_callback(
+                    host_backward,
+                    in_specs[0] if len(in_specs) == 1 else in_specs,
+                    xs, outs, cts)
+                return (grads,) if len(in_specs) == 1 else tuple(grads)
+
+            call.defvjp(call_fwd, call_bwd)
+            return call(*arrays)
+
+        _CUSTOM_FNS[reg_name] = op_fn
+        _register_op(reg_name)(op_fn)
+        return prop_cls
+
+    return deco
+
+
+_CUSTOM_FNS = {}
+
+
+def get_all_registered_operators():
+    return list(_CUSTOM)
+
+
+@_register_op("Custom")
+def _custom(*arrays, op_type=None, **attrs):
+    """mx.nd.Custom(data..., op_type='name') / sym.Custom parity entry."""
+    if op_type not in _CUSTOM_FNS:
+        raise ValueError("custom op %r is not registered" % (op_type,))
+    return _CUSTOM_FNS[op_type](*arrays, **attrs)

@@ -4,5 +4,6 @@ from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn  # noqa: F401  (registers nn ops)
 from . import rnn  # noqa: F401  (registers recurrent ops)
 from . import control_flow  # noqa: F401  (registers foreach/while_loop/cond)
+from . import contrib  # noqa: F401  (registers bbox/NMS/ROI detection ops)
 
 __all__ = ["Operator", "apply_op", "get", "invoke", "list_ops", "register"]

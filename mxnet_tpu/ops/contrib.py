@@ -1,0 +1,293 @@
+"""Contrib operators: bounding-box / detection ops.
+
+Reference: src/operator/contrib/bounding_box-inl.h (box_nms with the
+index-trick for XLA-hostile dynamic output counts), multibox_* (SSD anchors,
+src/operator/contrib/multibox_prior.cc), ROI pooling
+(src/operator/roi_pooling.cc).
+
+TPU-native design: everything is fixed-shape.  NMS keeps `topk` boxes and
+marks suppressed entries with -1 score instead of shrinking the output
+(exactly the trick the reference uses to keep shapes static); the O(k^2)
+suppression matrix runs as dense math on the MXU via lax.scan over a
+fixed-size loop, which XLA fuses — no serialized host loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _corner_iou(a, b):
+    """IoU of [..., 4] corner boxes (xmin,ymin,xmax,ymax)."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.clip(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * \
+        jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * \
+        jnp.clip(b[..., 3] - b[..., 1], 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _center_to_corner(x):
+    cx, cy, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register("box_iou", aliases=("_contrib_box_iou",))
+def _box_iou(lhs, rhs, format="corner", **_):
+    a = jnp.asarray(lhs)
+    b = jnp.asarray(rhs)
+    if format == "center":
+        a = _center_to_corner(a)
+        b = _center_to_corner(b)
+    return _corner_iou(a[..., :, None, :], b[..., None, :, :])
+
+
+def _nms_one(boxes, valid_thresh, overlap_thresh, topk, score_index,
+             coord_start, id_index, force_suppress):
+    """NMS for one [N, K] element array.  Returns same-shape output with
+    suppressed/invalid rows' score set to -1, sorted by score desc —
+    matching the reference's in-place semantics
+    (src/operator/contrib/bounding_box-inl.h)."""
+    n = boxes.shape[0]
+    scores = boxes[:, score_index]
+    valid = scores > valid_thresh
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    sorted_boxes = boxes[order]
+    sorted_valid = valid[order]
+    coords = lax.dynamic_slice_in_dim(sorted_boxes, coord_start, 4, axis=1)
+    iou = _corner_iou(coords[:, None, :], coords[None, :, :])
+    if id_index >= 0 and not force_suppress:
+        same_class = sorted_boxes[:, id_index][:, None] == \
+            sorted_boxes[:, id_index][None, :]
+        iou = jnp.where(same_class, iou, 0.0)
+    suppress_matrix = (iou > overlap_thresh) & sorted_valid[None, :]
+    if topk > 0:
+        in_topk = jnp.arange(n) < topk
+        sorted_valid = sorted_valid & in_topk
+
+    def body(keep, i):
+        # suppressed if any earlier kept box overlaps it
+        earlier = (jnp.arange(n) < i) & keep
+        sup = jnp.any(earlier & suppress_matrix[:, i])
+        keep = keep.at[i].set(keep[i] & ~sup)
+        return keep, None
+
+    keep0 = sorted_valid
+    keep, _ = lax.scan(body, keep0, jnp.arange(n))
+    return sorted_boxes.at[:, score_index].set(
+        jnp.where(keep, sorted_boxes[:, score_index], -1.0))
+
+
+@register("box_nms", aliases=("_contrib_box_nms", "box_non_maximum_suppression"))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             force_suppress=False, in_format="corner",
+             out_format="corner", **_):
+    x = jnp.asarray(data)
+    shape = x.shape
+    flat = x.reshape((-1,) + shape[-2:])
+    fn = lambda b: _nms_one(b, valid_thresh, overlap_thresh, int(topk),
+                            int(score_index), int(coord_start),
+                            int(id_index), bool(force_suppress))
+    out = jax.vmap(fn)(flat)
+    return out.reshape(shape)
+
+
+@register("bipartite_matching", aliases=("_contrib_bipartite_matching",),
+          differentiable=False, num_outputs=2)
+def _bipartite_matching(dist, is_ascend=False, threshold=0.5, topk=-1, **_):
+    """Greedy bipartite matching (reference:
+    src/operator/contrib/bipartite_matching.cc).  dist: [..., N, M]."""
+    x = jnp.asarray(dist)
+    shape = x.shape
+    flat = x.reshape((-1,) + shape[-2:])
+
+    def match_one(d):
+        n, m = d.shape
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(carry, _):
+            dd, row_match, col_used = carry
+            flat_idx = jnp.argmin(dd) if is_ascend else jnp.argmax(dd)
+            i, j = flat_idx // m, flat_idx % m
+            val = dd[i, j]
+            ok = (val <= threshold) if is_ascend else (val >= threshold)
+            row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+            col_used = jnp.where(ok, col_used.at[j].set(1), col_used)
+            dd = dd.at[i, :].set(big)
+            dd = dd.at[:, j].set(big)
+            return (dd, row_match, col_used), None
+
+        iters = min(n, m) if topk <= 0 else min(topk, min(n, m))
+        (d_, row_match, col_used), _ = lax.scan(
+            body, (d, jnp.full((n,), -1, jnp.int32),
+                   jnp.zeros((m,), jnp.int32)), None, length=iters)
+        return row_match.astype(jnp.float32), col_used.astype(jnp.float32)
+
+    rows, cols = jax.vmap(match_one)(flat)
+    return (rows.reshape(shape[:-1]), cols.reshape(shape[:-2] + shape[-1:]))
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **_):
+    """ROI max pooling (reference: src/operator/roi_pooling.cc).
+    data [B,C,H,W]; rois [R,5] (batch_idx, x1, y1, x2, y2)."""
+    x = jnp.asarray(data)
+    r = jnp.asarray(rois)
+    B, C, H, W = x.shape
+    ph, pw = pooled_size
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = jnp.round(roi[1:5] * spatial_scale)
+        h = jnp.maximum(y2 - y1 + 1, 1.0)
+        w = jnp.maximum(x2 - x1 + 1, 1.0)
+        y_lo = jnp.clip(jnp.floor(y1 + jnp.arange(ph) / ph * h), 0, H - 1)
+        y_hi = jnp.clip(jnp.ceil(y1 + (jnp.arange(ph) + 1) / ph * h), 1, H)
+        x_lo = jnp.clip(jnp.floor(x1 + jnp.arange(pw) / pw * w), 0, W - 1)
+        x_hi = jnp.clip(jnp.ceil(x1 + (jnp.arange(pw) + 1) / pw * w), 1, W)
+        img = x[b]  # [C, H, W]
+        # dense mask-based max per cell keeps shapes static
+        yy = jnp.arange(H)[None, :]
+        xx = jnp.arange(W)[None, :]
+        ymask = (yy >= y_lo[:, None]) & (yy < y_hi[:, None])   # [ph, H]
+        xmask = (xx >= x_lo[:, None]) & (xx < x_hi[:, None])   # [pw, W]
+        cell = ymask[:, None, None, :, None] & \
+            xmask[None, :, None, None, :]                       # [ph,pw,1,H,W]
+        vals = jnp.where(cell, img[None, None, :, :, :], -jnp.inf)
+        return jnp.max(vals, axis=(3, 4)).transpose(2, 0, 1)    # [C,ph,pw]
+
+    return jax.vmap(pool_one)(r)
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",),
+          differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **_):
+    """SSD anchor generation (reference:
+    src/operator/contrib/multibox_prior.cc).  data [B,C,H,W] ->
+    [1, H*W*(S+R-1), 4] corner anchors."""
+    x = jnp.asarray(data)
+    H, W = x.shape[-2], x.shape[-1]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / H
+    step_x = steps[0] if steps[0] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[1]) * step_y
+    cx = (jnp.arange(W) + offsets[0]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # [H,W,2]
+    whs = []
+    for s in sizes:
+        whs.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    whs = jnp.asarray(whs)  # [A, 2] (w, h)
+    cyx = cyx[:, :, None, :]
+    w = whs[None, None, :, 0] / 2
+    h = whs[None, None, :, 1] / 2
+    xmin = cyx[..., 1] - w
+    ymin = cyx[..., 0] - h
+    xmax = cyx[..., 1] + w
+    ymax = cyx[..., 0] + h
+    anchors = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)
+    anchors = anchors.reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+@register("box_encode", aliases=("_contrib_box_encode",), num_outputs=2)
+def _box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+                stds=(0.1, 0.1, 0.2, 0.2), **_):
+    """Encode matched boxes as regression targets (reference:
+    src/operator/contrib/bounding_box.cc box_encode)."""
+    a = jnp.asarray(anchors)
+    g = jnp.take_along_axis(jnp.asarray(refs),
+                            jnp.asarray(matches)[..., None].astype(jnp.int32),
+                            axis=-2)
+    aw = a[..., 2] - a[..., 0]
+    ah = a[..., 3] - a[..., 1]
+    ax = (a[..., 0] + a[..., 2]) / 2
+    ay = (a[..., 1] + a[..., 3]) / 2
+    gw = g[..., 2] - g[..., 0]
+    gh = g[..., 3] - g[..., 1]
+    gx = (g[..., 0] + g[..., 2]) / 2
+    gy = (g[..., 1] + g[..., 3]) / 2
+    tx = ((gx - ax) / jnp.maximum(aw, 1e-12) - means[0]) / stds[0]
+    ty = ((gy - ay) / jnp.maximum(ah, 1e-12) - means[1]) / stds[1]
+    tw = (jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-12), 1e-12))
+          - means[2]) / stds[2]
+    th = (jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-12), 1e-12))
+          - means[3]) / stds[3]
+    targets = jnp.stack([tx, ty, tw, th], axis=-1)
+    mask = (jnp.asarray(samples) > 0.5)[..., None]
+    return jnp.where(mask, targets, 0.0), mask.astype(targets.dtype)
+
+
+@register("box_decode", aliases=("_contrib_box_decode",))
+def _box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+                clip=-1.0, format="corner", **_):
+    d = jnp.asarray(data)
+    a = jnp.asarray(anchors)
+    if format == "corner":
+        aw = a[..., 2] - a[..., 0]
+        ah = a[..., 3] - a[..., 1]
+        ax = (a[..., 0] + a[..., 2]) / 2
+        ay = (a[..., 1] + a[..., 3]) / 2
+    else:
+        ax, ay, aw, ah = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    ox = d[..., 0] * std0 * aw + ax
+    oy = d[..., 1] * std1 * ah + ay
+    dw = d[..., 2] * std2
+    dh = d[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * aw / 2
+    oh = jnp.exp(dh) * ah / 2
+    return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+
+# ----------------------------------------------------- quantization primitives
+# (reference: src/operator/quantization/quantize_v2.cc, dequantize.cc; the
+# contrib.quantization driver builds on these)
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",),
+          differentiable=False, num_outputs=3)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8", **_):
+    x = jnp.asarray(data)
+    lo = jnp.asarray(min_calib_range if min_calib_range is not None
+                     else x.min())
+    hi = jnp.asarray(max_calib_range if max_calib_range is not None
+                     else x.max())
+    amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    scale = 127.0 / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32", **_):
+    q = jnp.asarray(data).astype(jnp.float32)
+    amax = jnp.maximum(jnp.abs(jnp.asarray(min_range)),
+                       jnp.abs(jnp.asarray(max_range)))
+    return q * (amax / 127.0)
+
+
+@register("_sim_quant", differentiable=False)
+def _sim_quant(data, amax=1.0, **_):
+    """Simulated-affine int8: round onto the int8 grid, stay f32 (AQT
+    pattern — keeps every op a pure jax function on MXU-friendly dtypes)."""
+    s = 127.0 / max(float(amax), 1e-12)
+    return jnp.clip(jnp.round(jnp.asarray(data) * s), -127, 127) / s

@@ -119,11 +119,11 @@ class Symbol:
         return iter(heads)
 
     def __getitem__(self, idx):
-        if self.kind == "group":
-            return self.inputs[idx]
         if isinstance(idx, str):
             names = self.list_outputs()
             idx = names.index(idx)
+        if self.kind == "group":
+            return self.inputs[idx]
         if _node_num_outputs(self) > 1:
             return Symbol("slice", "%s%d" % (self.name, idx),
                           inputs=[self], index=idx)
@@ -474,6 +474,11 @@ def _emb_param_shapes(attrs, dshape):
 
 _INT_DATA_OPS = {"Embedding", "one_hot", "take"}
 
+# unary ops that preserve their input's shape — partial shape inference may
+# propagate parameter shapes through them
+_SHAPE_TRANSPARENT = {"cast", "_sim_quant", "identity", "BlockGrad",
+                      "Dropout", "make_loss", "negative", "relu", "abs"}
+
 _PARAM_SHAPE_RULES = {
     "FullyConnected": _fc_param_shapes,
     "Convolution": _conv_param_shapes,
@@ -527,12 +532,24 @@ def _infer_shapes_partial(sym, known, dtypes=None):
         if rule is not None and shapes and shapes[0] is not None:
             derived = rule(node.attrs, shapes[0])
             for i, shp in derived.items():
-                if i < len(node.inputs) and isinstance(node.inputs[i], Symbol) \
-                        and node.inputs[i].kind == "var" \
-                        and shapes[i] is None:
-                    shapes[i] = tuple(shp)
-                    var_shapes[node.inputs[i].name] = tuple(shp)
-                    out_shapes[(id(node.inputs[i]), 0)] = tuple(shp)
+                if i >= len(node.inputs) or shapes[i] is not None or \
+                        not isinstance(node.inputs[i], Symbol):
+                    continue
+                # follow shape-preserving unary wrappers (cast/_sim_quant/
+                # BlockGrad...) down to the parameter variable they wrap —
+                # AMP and quantization passes interpose these
+                chain = [node.inputs[i]]
+                while chain[-1].kind == "op" and \
+                        chain[-1].op in _SHAPE_TRANSPARENT and \
+                        isinstance(chain[-1].inputs[0], Symbol):
+                    chain.append(chain[-1].inputs[0])
+                leaf = chain[-1]
+                if leaf.kind != "var":
+                    continue
+                shapes[i] = tuple(shp)
+                var_shapes[leaf.name] = tuple(shp)
+                for c in chain:
+                    out_shapes[(id(c), 0)] = tuple(shp)
         if any(s is None and x is not None
                for s, x in zip(shapes, node.inputs)):
             continue  # unknown inputs: leave this node's outputs unknown

@@ -1,0 +1,148 @@
+"""Contrib ops / custom op bridge / quantization tests (reference analog:
+tests/python/unittest/test_contrib_operator.py, test_operator.py custom op
+section, tests/python/quantization/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_box_iou():
+    a = mx.nd.array(np.array([[0, 0, 2, 2]], np.float32))
+    b = mx.nd.array(np.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                              [10, 10, 11, 11]], np.float32))
+    iou = mx.nd.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_nms_suppresses():
+    # [score_class, score, x1,y1,x2,y2] layout: id_index=0, score_index=1
+    boxes = np.array([[
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 10.5, 10.5],   # overlaps first -> suppressed
+        [0, 0.7, 20, 20, 30, 30],     # far away -> kept
+        [0, 0.05, 0, 0, 1, 1],        # below valid_thresh -> invalid
+    ]], np.float32)
+    out = mx.nd.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                        valid_thresh=0.1, coord_start=2, score_index=1,
+                        id_index=0).asnumpy()
+    scores = out[0, :, 1]
+    kept = scores[scores > 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept, reverse=True), [0.9, 0.7],
+                               rtol=1e-6)
+
+
+def test_box_nms_class_aware():
+    boxes = np.array([[
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 1, 1, 10.5, 10.5],   # overlaps but different class
+    ]], np.float32)
+    out = mx.nd.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                        coord_start=2, score_index=1, id_index=0,
+                        force_suppress=False).asnumpy()
+    assert (out[0, :, 1] > 0).sum() == 2
+    out2 = mx.nd.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                         coord_start=2, score_index=1, id_index=0,
+                         force_suppress=True).asnumpy()
+    assert (out2[0, :, 1] > 0).sum() == 1
+
+
+def test_multibox_prior():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0, 0]
+    np.testing.assert_allclose(a[2] - a[0], 0.5, rtol=1e-5)
+
+
+def test_roi_pooling():
+    x = np.arange(1 * 1 * 8 * 8, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(x), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, 1, 1], x[0, 0, 3, 3])
+
+
+def test_custom_op_forward_backward():
+    @mx.operator.register("mysigmoid")
+    class MySigmoidProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class MySigmoid(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    y = 1.0 / (1.0 + np.exp(-in_data[0].asnumpy()))
+                    self.assign(out_data[0], req[0], y.astype(np.float32))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    y = out_data[0].asnumpy()
+                    g = out_grad[0].asnumpy() * y * (1 - y)
+                    self.assign(in_grad[0], req[0], g.astype(np.float32))
+            return MySigmoid()
+
+    x = mx.nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.mysigmoid(x)
+        loss = y.sum()
+    loss.backward()
+    expect = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect * (1 - expect),
+                               rtol=1e-5)
+    # nd.Custom(op_type=...) parity path
+    y2 = mx.nd.Custom(x, op_type="mysigmoid")
+    np.testing.assert_allclose(y2.asnumpy(), expect, rtol=1e-5)
+
+
+def test_quantize_dequantize_ops():
+    x = np.random.RandomState(0).uniform(-3, 3, (4, 4)).astype(np.float32)
+    q, lo, hi = mx.nd.quantize_v2(mx.nd.array(x), min_calib_range=-3.0,
+                                  max_calib_range=3.0)
+    assert q.asnumpy().dtype == np.int8
+    back = mx.nd.dequantize(q, lo, hi).asnumpy()
+    np.testing.assert_allclose(back, x, atol=3.0 / 127 + 1e-6)
+
+
+def test_calib_thresholds_modes():
+    from mxnet_tpu.contrib.quantization import calib_thresholds
+    rng = np.random.RandomState(0)
+    acts = {"a": rng.normal(0, 1, 10000).astype(np.float32)}
+    naive = calib_thresholds(acts, mode="naive")
+    entropy = calib_thresholds(acts, mode="entropy")
+    assert naive["a"] >= entropy["a"] > 0   # KL clips outliers
+
+
+def test_quantize_model_e2e():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 3)).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    f = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    f = mx.sym.Activation(f, act_type="relu")
+    f = mx.sym.FullyConnected(f, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(f, label, name="softmax")
+
+    mod = mx.mod.Module(out)
+    train = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    fp_acc = mod.score(mx.io.NDArrayIter(X, Y, batch_size=16), "acc")[0][1]
+    arg, aux = mod.get_params()
+
+    from mxnet_tpu.contrib.quantization import quantize_model
+    calib = mx.io.NDArrayIter(X, Y, batch_size=16)
+    qsym, qarg, qaux = quantize_model(out, arg, aux,
+                                      calib_mode="naive", calib_data=calib)
+    qmod = mx.mod.Module(qsym)
+    qmod.bind([("data", (16, 8))], [("softmax_label", (16,))],
+              for_training=False)
+    qmod.set_params(qarg, qaux)
+    q_acc = qmod.score(mx.io.NDArrayIter(X, Y, batch_size=16), "acc")[0][1]
+    assert q_acc >= fp_acc - 0.1, (fp_acc, q_acc)

@@ -1,0 +1,74 @@
+"""Native C++ IO runtime tests (src/native) — reference analog: the dmlc
+recordio + prefetcher layer the reference keeps native (SURVEY.md §2.1 Data
+IO).  Skipped when no C++ toolchain is present."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+native = pytest.importorskip("mxnet_tpu.native")
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+
+def test_native_record_read(tmp_path):
+    p = str(tmp_path / "t.rec")
+    w = mx.recordio.MXRecordIO(p, "w")
+    payloads = [bytes([i]) * (i + 1) for i in range(50)]
+    for b in payloads:
+        w.write(b)
+    w.close()
+    f = native.NativeRecordFile(p)
+    assert len(f) == 50
+    for i in (0, 7, 49, 3):
+        assert f.read_index(i) == payloads[i]
+    f.close()
+
+
+def test_native_matches_python_reader(tmp_path):
+    p = str(tmp_path / "t.rec")
+    rng = np.random.RandomState(0)
+    w = mx.recordio.MXRecordIO(p, "w")
+    payloads = [rng.bytes(rng.randint(1, 2000)) for _ in range(20)]
+    for b in payloads:
+        w.write(b)
+    w.close()
+    f = native.NativeRecordFile(p)
+    r = mx.recordio.MXRecordIO(p, "r")
+    for i in range(20):
+        assert f.read_index(i) == r.read() == payloads[i]
+
+
+def test_native_continuation_assembly(tmp_path, monkeypatch):
+    import mxnet_tpu.recordio as rio
+    monkeypatch.setattr(rio, "_LENGTH_MASK", 63)
+    p = str(tmp_path / "big.rec")
+    payload = bytes(range(256)) * 3
+    w = rio.MXRecordIO(p, "w")
+    w.write(payload)
+    w.write(b"tail")
+    w.close()
+    f = native.NativeRecordFile(p)
+    assert len(f) == 2
+    assert f.read_index(0) == payload
+    assert f.read_index(1) == b"tail"
+
+
+def test_native_csv_parse(tmp_path):
+    p = str(tmp_path / "d.csv")
+    arr = np.random.RandomState(0).uniform(-5, 5, (32, 7)).astype(np.float32)
+    np.savetxt(p, arr, delimiter=",")
+    got = native.csv_parse(p)
+    np.testing.assert_allclose(got, arr, rtol=1e-5)
+
+
+def test_imageiter_uses_native(tmp_path):
+    from tests.test_io_image import _make_rec_dataset
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec)
+    from mxnet_tpu.image.image import _NativeRecAdapter
+    assert isinstance(it._rec, _NativeRecAdapter)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 16, 16)
