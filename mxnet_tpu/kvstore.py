@@ -166,8 +166,8 @@ class KVStore:
     def barrier(self):
         """Global barrier across workers (reference: KVStore::Barrier)."""
         if self._is_dist and self.num_workers > 1:
-            from .parallel import host_barrier
-            host_barrier()
+            from .parallel import barrier
+            barrier()
 
     def _send_command_to_servers(self, head, body):
         pass

@@ -10,6 +10,8 @@ ps-lite scheduler rendezvous.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -31,8 +33,20 @@ __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
     """Multi-host rendezvous — the ps-lite scheduler analog
-    (DMLC_PS_ROOT_URI env rendezvous, src/kvstore/kvstore_dist.h).  Reads
-    standard cluster env when args are None."""
+    (DMLC_PS_ROOT_URI env rendezvous, src/kvstore/kvstore_dist.h:44-50).
+
+    Argument resolution order, mirroring how the reference's roles come from
+    the dmlc tracker env (DMLC_PS_ROOT_URI / DMLC_NUM_WORKER / DMLC_ROLE,
+    tools/launch.py): explicit args > ``MXTPU_COORDINATOR`` /
+    ``MXTPU_NUM_PROCESSES`` / ``MXTPU_PROCESS_ID`` env (set by our
+    tools/launch.py) > jax cluster auto-detection (SLURM/GKE/etc.).
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXTPU_COORDINATOR")
+    if num_processes is None and "MXTPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MXTPU_NUM_PROCESSES"])
+    if process_id is None and "MXTPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MXTPU_PROCESS_ID"])
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

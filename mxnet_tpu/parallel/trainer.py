@@ -25,10 +25,19 @@ from .mesh import data_parallel_mesh
 __all__ = ["SPMDTrainer", "build_train_step"]
 
 
-def _opt_hyper(optimizer, index):
-    lr = optimizer._get_lr(index)
-    wd = optimizer._get_wd(index)
-    return lr, wd
+def _opt_hyper_arrays(optimizer, num_params):
+    """Evaluate per-parameter lr/wd EAGERLY for the current num_update.
+
+    These are fed into the jitted step as traced arguments so an
+    ``lr_scheduler`` (reference: python/mxnet/lr_scheduler.py) keeps working —
+    evaluating them at trace time would constant-fold the schedule into the
+    compiled program and silently freeze it at the first step's value.
+    """
+    lrs = jnp.asarray([optimizer._get_lr(i) for i in range(num_params)],
+                      jnp.float32)
+    wds = jnp.asarray([optimizer._get_wd(i) for i in range(num_params)],
+                      jnp.float32)
+    return lrs, wds
 
 
 class SPMDTrainer:
@@ -126,7 +135,7 @@ class SPMDTrainer:
             return loss, (new_aux, out)
 
         def step(train_params, aux_params, opt_state, data, label, key, t,
-                 lr_scale):
+                 lrs, wds, lr_scale):
             (loss, (new_aux, _)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_params, aux_params, data, label,
                                        key)
@@ -138,10 +147,10 @@ class SPMDTrainer:
             # program — keep a trace key scope open for the update loop.
             with _random.trace_key_scope(jax.random.fold_in(key, 1)):
                 for i, n in enumerate(trainable):
-                    lr, wd = _opt_hyper(optimizer, i)
                     w, s = optimizer.step(train_params[n],
                                           _preprocess(optimizer, grads[n]),
-                                          opt_state[n], lr * lr_scale, wd, t)
+                                          opt_state[n], lrs[i] * lr_scale,
+                                          wds[i], t)
                     new_params[n] = w.astype(train_params[n].dtype)
                     new_state[n] = s
             aux_out = dict(aux_params)
@@ -174,13 +183,14 @@ class SPMDTrainer:
         label = jax.device_put(jnp.asarray(label), self._batch_sharding)
         self._step_num += 1
         self.optimizer.num_update = self._step_num
+        lrs, wds = _opt_hyper_arrays(self.optimizer, len(self.fn.trainable))
         from .. import random as _random
         key = _random.new_eager_seed_key()
         train = {n: self.params[n] for n in self.fn.trainable}
         aux = {n: self.params[n] for n in self.fn.aux}
         new_train, new_aux, self.opt_state, loss = self._jitted(
             train, aux, self.opt_state, data, label, key,
-            jnp.asarray(self._step_num, jnp.int32),
+            jnp.asarray(self._step_num, jnp.int32), lrs, wds,
             jnp.asarray(lr_scale, jnp.float32))
         self.params = {}
         self.params.update(new_train)
@@ -190,6 +200,61 @@ class SPMDTrainer:
     def sync(self):
         """Write device params back into the Block's Parameters."""
         self.fn.write_back(self.params)
+
+    # ---------------------------------------------------------- checkpoint
+    def save_checkpoint(self, path):
+        """Save params + optimizer state + step count to ``path``.
+
+        The SPMD analog of Module checkpointing (reference:
+        python/mxnet/model.py:394-442 save_checkpoint) plus Trainer optimizer
+        state (python/mxnet/gluon/trainer.py:436 save_states) in ONE file:
+        there is no symbol/params split because the program is the jitted
+        step, and optimizer state lives beside the weights it shards with.
+        Arrays are gathered to host; `load_checkpoint` re-places them with
+        the trainer's own shardings, so the mesh shape may differ between
+        save and restore (e.g. checkpoint on 8 chips, resume on 16).
+        """
+        import numpy as np
+        import pickle
+        from .. import random as _random
+        if self.params is None:
+            raise ValueError("nothing to checkpoint: trainer has no "
+                             "materialized params (run a step first)")
+        host = {
+            "step_num": self._step_num,
+            "params": {n: _to_host(v) for n, v in self.params.items()},
+            "opt_state": jax.tree_util.tree_map(_to_host, self.opt_state),
+            # The eager PRNG stream position: models that draw per step
+            # (dropout, SGLD) must resume on the same key sequence for the
+            # bitwise-continue guarantee to hold.
+            "rng_key": np.asarray(_random._global_key()),
+        }
+        with open(path, "wb") as f:
+            pickle.dump(host, f)
+
+    def load_checkpoint(self, path):
+        """Restore a `save_checkpoint` file; training continues bitwise
+        where it left off (same data ⇒ same loss curve)."""
+        import pickle
+        from .. import random as _random
+        with open(path, "rb") as f:
+            host = pickle.load(f)
+        self._step_num = host["step_num"]
+        self.optimizer.num_update = self._step_num
+        self.params = {n: jnp.asarray(v) for n, v in host["params"].items()}
+        self.opt_state = host["opt_state"]
+        self._place()
+        if "rng_key" in host:
+            _random._STATE.key = jnp.asarray(host["rng_key"])
+
+
+def _to_host(x):
+    """Gather a (possibly multi-host-sharded) array to a host numpy array."""
+    import numpy as np
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
 
 
 def _state_to_jax(st):

@@ -22,7 +22,13 @@ __all__ = ["seed", "next_key", "uniform", "normal", "randint", "randn",
 
 class _KeyState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # None = "key not materialized yet" (seed in self.seed_val).  Neither
+        # importing the package nor seed() may initialize an XLA backend:
+        # jax.distributed.initialize() (parallel.initialize) is only legal
+        # BEFORE first backend init, and `mx.random.seed(...)` at the top of
+        # a script is a standard MXNet pattern.
+        self.key = None
+        self.seed_val = 0
         self.counter = 0
         self.trace_stack = []
 
@@ -30,9 +36,17 @@ class _KeyState(threading.local):
 _STATE = _KeyState()
 
 
+def _global_key():
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(_STATE.seed_val)
+    return _STATE.key
+
+
 def seed(seed_state, ctx="all"):
-    """Set the global seed (reference: MXRandomSeed / mx.random.seed)."""
-    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    """Set the global seed (reference: MXRandomSeed / mx.random.seed).
+    Lazy: the device key materializes on first draw."""
+    _STATE.seed_val = int(seed_state)
+    _STATE.key = None
     _STATE.counter = 0
 
 
@@ -43,7 +57,7 @@ def next_key():
         key, sub = jax.random.split(_STATE.trace_stack[-1])
         _STATE.trace_stack[-1] = key
         return sub
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    _STATE.key, sub = jax.random.split(_global_key())
     return sub
 
 
@@ -69,7 +83,7 @@ def new_eager_seed_key():
     traced key instead."""
     if _STATE.trace_stack:
         return next_key()
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    _STATE.key, sub = jax.random.split(_global_key())
     return sub
 
 

@@ -1,0 +1,57 @@
+"""Worker body for tests/test_dist.py — value-exact dist_sync semantics.
+
+Reference contract: ``tests/nightly/dist_sync_kvstore.py:26-60`` — every
+worker pushes, the merge is the sum of all NumWorkers contributions, and a
+subsequent pull observes exactly that merged value on every worker.  Run as N
+local processes by tools/launch.py (the reference CI pattern,
+``ci/docker/runtime_functions.sh:1366-1374``).
+
+Not a pytest file: launched as a subprocess with MXTPU_* rendezvous env.
+"""
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+
+    parallel.initialize()
+    import jax
+    rank = jax.process_index()
+    nworker = jax.process_count()
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank, (kv.rank, rank)
+    assert kv.num_workers == nworker, (kv.num_workers, nworker)
+
+    # Shape fixture in the spirit of dist_sync_kvstore.py keys 3/5/7/9.
+    shapes = {"3": (4, 4), "5": (7, 3), "9": (2, 5, 2)}
+    for k, shp in shapes.items():
+        kv.init(k, mx.nd.ones(shp))
+    kv.barrier()
+
+    expect = float(sum(r + 1 for r in range(nworker)))
+    for _round in range(3):
+        for k, shp in shapes.items():
+            kv.push(k, mx.nd.ones(shp) * (rank + 1))
+            out = mx.nd.zeros(shp)
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(), expect)
+        kv.barrier()
+
+    # pushpull combined path.
+    for k, shp in shapes.items():
+        val = mx.nd.ones(shp) * (rank + 1)
+        kv.pushpull(k, val, out=val)
+        np.testing.assert_allclose(val.asnumpy(), expect)
+
+    # host_allreduce directly (the DCN allreduce primitive).
+    local = np.full((3, 2), rank + 1.0, np.float32)
+    total = np.asarray(parallel.host_allreduce(local))
+    np.testing.assert_allclose(total, expect)
+
+    print("WORKER_OK rank=%d/%d" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Multi-process launcher — the analog of the reference's dmlc-tracker
+launcher (``tools/launch.py:80-100``, launchers local/ssh/mpi/sge/yarn).
+
+On a TPU pod each host runs ONE copy of the same SPMD program; there are no
+separate server/scheduler roles (the ps-lite parameter server collapses into
+XLA collectives, SURVEY.md §5.8).  So the launcher's job reduces to: pick a
+coordinator address, start N copies of the command with rendezvous env vars,
+and propagate failure.  This reproduces on one host the CI pattern the
+reference uses for its nightly dist kvstore tests
+(``ci/docker/runtime_functions.sh:1366-1374``: N workers as local processes).
+
+Usage::
+
+    python tools/launch.py -n 4 python train.py ...
+
+Each worker process then calls ``mxnet_tpu.parallel.initialize()`` (or
+creates a ``dist_*`` kvstore, which does so implicitly) and finds its rank
+via the ``MXTPU_*`` env this launcher sets.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, command, extra_env=None):
+    """Start `num_workers` local processes with rendezvous env; returns the
+    max worker return code (0 iff all succeeded)."""
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env.update({
+            "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_NUM_PROCESSES": str(num_workers),
+            "MXTPU_PROCESS_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            rc = max(rc, p.wait())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("--launcher", default="local", choices=["local"],
+                    help="only 'local' is implemented; on real multi-host "
+                         "TPU use your cluster scheduler (GKE/SLURM) — jax "
+                         "auto-detects those in parallel.initialize()")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command line")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("missing worker command")
+    sys.exit(launch_local(args.num_workers, args.command))
+
+
+if __name__ == "__main__":
+    main()
