@@ -3,47 +3,142 @@
 Mirrors the reference harness `example/image-classification/train_imagenet.py
 --benchmark 1` (synthetic-data training throughput); baseline is the
 reference's published 363.69 img/s fp32 @BS128 on 1xV100
-(docs/static_site/src/pages/api/faq/perf.md:254, see BASELINE.md).
+(docs/static_site/src/pages/api/faq/perf.md:247-256, see BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Hardened against backend flakiness (the round-1 failure mode): nothing
+touches a device before an explicit retried backend probe, every phase runs
+under a watchdog, and any failure is reported as a parseable JSON line with
+value 0 instead of a traceback.
 """
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 
-import numpy as np
-
 BASELINE_IMG_S = 363.69  # ResNet-50 fp32 train, 1xV100, BS128
+WATCHDOG_S = float(os.environ.get("MXTPU_BENCH_TIMEOUT", "520"))
+PROBE_ATTEMPT_S = 100.0
+
+# ResNet-50 fwd FLOPs/image at 224x224 ~ 4.1e9; a train step ~ 3x fwd
+# (forward + grad-wrt-activations + grad-wrt-weights).
+TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
 
 
-def main():
+def _probe_backend(retries=3):
+    """Initialize the default jax backend with retry + per-attempt timeout.
+
+    Returns (devices, error_string).  Runs each attempt in a daemon thread
+    because a stale TPU-tunnel init can HANG rather than raise.
+    """
     import jax
+
+    last_err = None
+    for attempt in range(retries):
+        box = {}
+
+        def attempt_init():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001
+                box["error"] = "%s: %s" % (type(e).__name__, e)
+
+        t = threading.Thread(target=attempt_init, daemon=True)
+        t.start()
+        t.join(PROBE_ATTEMPT_S)
+        if "devices" in box:
+            return box["devices"], None
+        if "error" not in box:
+            # Init HUNG (not raised).  The stuck thread still holds jax's
+            # _backend_lock inside backends(), so _clear_backends() and any
+            # retry would block on the same lock — report immediately.
+            return None, "backend init hang (> %.0fs)" % PROBE_ATTEMPT_S
+        last_err = box["error"]
+        # Init FAILED cleanly: clear cached backend state so the retry is
+        # real (the lock is free; clear still guarded by a timeout).
+        _timed_call(jax._src.xla_bridge._clear_backends, 10.0,
+                    "backend cache clear")
+        time.sleep(4.0 * (attempt + 1))
+    return None, last_err
+
+
+def _timed_call(fn, timeout_s, label):
+    """Run fn() in a daemon thread; (result, err) with hang detection."""
+    box = {}
+
+    def call():
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001
+            box["error"] = "%s: %s: %s" % (label, type(e).__name__, e)
+
+    t = threading.Thread(target=call, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "result" in box:
+        return box["result"], None
+    return None, box.get("error", "%s hang (> %.0fs)" % (label, timeout_s))
+
+
+def run_bench():
+    import jax
+
+    devices, err = _probe_backend()
+    if devices is None:
+        return {"metric": "resnet50_train_throughput", "value": 0,
+                "unit": "img/s", "vs_baseline": 0,
+                "error": "backend init failed: %s" % err}
+    platform = devices[0].platform
+
+    # Fail fast if the device executes nothing (a tunnel that initializes
+    # but then stalls would otherwise eat the whole watchdog silently).
+    if platform != "cpu":
+        import jax.numpy as jnp
+        _, err = _timed_call(
+            lambda: jax.block_until_ready(jnp.ones((8, 8)) + 1.0),
+            120.0, "device smoke op")
+        if err is not None:
+            return {"metric": "resnet50_train_throughput", "value": 0,
+                    "unit": "img/s", "vs_baseline": 0, "platform": platform,
+                    "error": err}
+
+    import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh
 
-    batch = 128
-    net = vision.get_model("resnet50_v1", classes=1000)
-    net.initialize(mx.init.Xavier())
-
-    mesh = make_mesh({"dp": -1})  # 1 chip under the driver; dp-scales as-is
-    trainer = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
-                          {"learning_rate": 0.1, "momentum": 0.9,
-                           "wd": 1e-4},
-                          mesh=mesh)
-
+    batch = 128 if platform != "cpu" else 16
     rng = np.random.RandomState(0)
     data = rng.uniform(size=(batch, 3, 224, 224)).astype(np.float32)
     label = rng.randint(0, 1000, (batch,)).astype(np.float32)
 
-    # warmup (compile)
-    for _ in range(3):
+    mesh = make_mesh({"dp": -1})  # 1 chip under the driver; dp-scales as-is
+
+    # ALL eager prep (param init, deferred-shape first forward, optimizer
+    # state creation) runs pinned to the host CPU backend: over a remote
+    # device tunnel every eager op is a round trip, and ResNet-50 init is
+    # hundreds of them.  The device then sees only the bulk param transfer
+    # (inside _materialize's _place) and the one compiled train step.
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu0):
+        net = vision.get_model("resnet50_v1", classes=1000)
+        net.initialize(mx.init.Xavier())
+        trainer = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9,
+                               "wd": 1e-4},
+                              mesh=mesh)
+        trainer._materialize(data)
+
+    # warmup (compile + transfer)
+    for _ in range(2):
         loss = trainer.step(data, label)
     jax.block_until_ready(loss)
 
-    iters = 20
+    iters = 20 if platform != "cpu" else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = trainer.step(data, label)
@@ -51,12 +146,40 @@ def main():
     dt = time.perf_counter() - t0
 
     img_s = batch * iters / dt
-    print(json.dumps({
+    return {
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+        "platform": platform,
+        "batch": batch,
+        "tflops": round(img_s * TRAIN_FLOPS_PER_IMG / 1e12, 2),
+    }
+
+
+def main():
+    result = {}
+
+    def worker():
+        try:
+            result.update(run_bench())
+        except BaseException as e:  # noqa: BLE001
+            result.setdefault("metric", "resnet50_train_throughput")
+            result.setdefault("value", 0)
+            result.setdefault("unit", "img/s")
+            result.setdefault("vs_baseline", 0)
+            result["error"] = "%s: %s" % (type(e).__name__, e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(WATCHDOG_S)
+    if not result:
+        result = {"metric": "resnet50_train_throughput", "value": 0,
+                  "unit": "img/s", "vs_baseline": 0,
+                  "error": "watchdog timeout after %.0fs" % WATCHDOG_S}
+    print(json.dumps(result), flush=True)
+    # rc 0 iff a real number landed; stdout stays parseable either way.
+    os._exit(0 if result.get("value", 0) > 0 else 2)
 
 
 if __name__ == "__main__":

@@ -43,6 +43,13 @@ class KVStore:
         self._updater_obj = None
         self._compression_params = None
         self._is_dist = kv_type.startswith("dist")
+        if self._is_dist:
+            # Creating a dist kvstore IS the worker's rendezvous in the
+            # reference (ps::KVWorker construction, kvstore_dist.h:44-50);
+            # mirror that: join the jax.distributed cluster if a launcher
+            # provided one and we have not joined yet.
+            from .parallel import ensure_initialized
+            ensure_initialized()
 
     # --------------------------------------------------------------- meta
     @property

@@ -28,7 +28,7 @@ __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "PartitionSpec", "ring_attention", "attention",
            "ring_self_attention_sharded", "functionalize", "BlockFunction",
            "SPMDTrainer", "build_train_step", "host_allreduce",
-           "initialize", "barrier"]
+           "initialize", "ensure_initialized", "barrier"]
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
@@ -50,6 +50,20 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def ensure_initialized():
+    """Idempotent rendezvous: initialize jax.distributed iff launcher env is
+    present and it has not been initialized yet.  Lets ``mx.kv.create
+    ('dist_sync')`` alone bootstrap a worker, the way creating a dist kvstore
+    connects to the parameter server in the reference
+    (src/kvstore/kvstore_dist.h:44-50)."""
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
+        return
+    if ("MXTPU_COORDINATOR" in os.environ
+            or "JAX_COORDINATOR_ADDRESS" in os.environ):
+        initialize()
 
 
 def host_allreduce(val):

@@ -15,12 +15,14 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
 
-    parallel.initialize()
+    # No explicit parallel.initialize(): creating the dist kvstore must
+    # bootstrap the rendezvous from the launcher env by itself (the
+    # documented contract; reference ps::KVWorker ctor behavior).
+    kv = mx.kv.create("dist_sync")
     import jax
     rank = jax.process_index()
     nworker = jax.process_count()
-
-    kv = mx.kv.create("dist_sync")
+    assert nworker > 1, "rendezvous did not happen (process_count==1)"
     assert kv.rank == rank, (kv.rank, rank)
     assert kv.num_workers == nworker, (kv.num_workers, nworker)
 

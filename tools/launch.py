@@ -26,6 +26,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -36,9 +37,14 @@ def _free_port():
     return port
 
 
-def launch_local(num_workers, command, extra_env=None):
-    """Start `num_workers` local processes with rendezvous env; returns the
-    max worker return code (0 iff all succeeded)."""
+def launch_local(num_workers, command, extra_env=None, poll_interval=0.2):
+    """Start `num_workers` local processes with rendezvous env.
+
+    Returns 0 iff every worker exited 0.  Workers are polled concurrently:
+    the first non-zero (or signal-killed, negative-returncode) exit aborts
+    the whole job and SIGTERMs the survivors — otherwise ranks blocked in a
+    rendezvous/barrier waiting on the dead rank would hang forever.
+    """
     coordinator = "127.0.0.1:%d" % _free_port()
     procs = []
     for rank in range(num_workers):
@@ -52,12 +58,26 @@ def launch_local(num_workers, command, extra_env=None):
         procs.append(subprocess.Popen(command, env=env))
     rc = 0
     try:
-        for p in procs:
-            rc = max(rc, p.wait())
+        live = list(procs)
+        while live and rc == 0:
+            time.sleep(poll_interval)
+            still = []
+            for p in live:
+                code = p.poll()
+                if code is None:
+                    still.append(p)
+                elif code != 0:  # crash or signal (negative) — abort job
+                    rc = 1
+            live = still
     finally:
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
     return rc
 
 
