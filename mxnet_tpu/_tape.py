@@ -65,21 +65,33 @@ class Node:
     ``vjp_fn(cotangents_tuple) -> tuple(input_cotangents)`` where cotangents
     align 1:1 with outputs/inputs.  ``None`` cotangents are allowed and mean
     "no gradient flows here".
+
+    ``primal_fn`` (optional) is the pure jax function of the node's NDArray
+    inputs.  It is what makes ``create_graph=True`` possible: the backward
+    pass re-derives the vjp *as a jax function of (primals, cotangents)* and
+    records its application as a fresh tape node, so gradient outputs stay
+    differentiable to arbitrary order (reference contract:
+    tests/python/unittest/test_higher_order_grad.py).
     """
 
-    __slots__ = ("inputs", "outputs", "vjp_fn", "name", "_visited")
+    __slots__ = ("inputs", "outputs", "vjp_fn", "name", "_visited",
+                 "primal_fn", "primal_multi")
 
-    def __init__(self, inputs, outputs, vjp_fn, name=""):
+    def __init__(self, inputs, outputs, vjp_fn, name="", primal_fn=None,
+                 primal_multi=False):
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.vjp_fn = vjp_fn
         self.name = name
         self._visited = False
+        self.primal_fn = primal_fn
+        self.primal_multi = primal_multi
 
 
-def record_node(inputs, outputs, vjp_fn, name="") -> Node:
+def record_node(inputs, outputs, vjp_fn, name="", primal_fn=None,
+                primal_multi=False) -> Node:
     """Attach a new tape node to its output arrays."""
-    node = Node(inputs, outputs, vjp_fn, name)
+    node = Node(inputs, outputs, vjp_fn, name, primal_fn, primal_multi)
     for i, out in enumerate(node.outputs):
         out._tape_node = node
         out._tape_index = i
@@ -123,13 +135,23 @@ def _toposort(roots: Sequence[Any]) -> List[Node]:
     return order
 
 
-def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
-    """Run reverse accumulation from ``outputs``.
+def _key(arr):
+    node = getattr(arr, "_tape_node", None)
+    return (id(node), arr._tape_index) if node is not None \
+        else ("leaf", id(arr))
 
-    Populates ``arr._grad`` on every reachable leaf marked via
-    ``mark_variable`` (i.e. ``attach_grad``), honoring grad_req write/add.
+
+def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
+    """The single reverse-accumulation engine behind both ``backward`` and
+    ``grad_arrays``.
+
+    Returns (cotan, leaf_by_id): cotangents keyed by ``_key`` and every
+    reachable leaf array.  In create_graph mode cotangents are NDArrays and
+    all backward math is itself recorded on the tape (see
+    ``_recorded_node_backward``); otherwise they are raw jax arrays.
     """
     import jax.numpy as jnp
+    from .ndarray.ndarray import _wrap
 
     outputs = list(outputs)
     if head_grads is None:
@@ -139,55 +161,61 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
         if len(head_grads) != len(outputs):
             raise ValueError("head_grads length mismatch")
 
-    # cotangent accumulator keyed by (id(node), out_index) plus leaves by id(arr)
     cotan = {}
-
-    def _key(arr):
-        return (id(arr._tape_node), arr._tape_index) if arr._tape_node is not None else ("leaf", id(arr))
+    leaf_by_id = {}
 
     def _acc(key, val):
         if val is None:
             return
         if key in cotan:
-            cotan[key] = jnp.add(cotan[key], val)
+            prev = cotan[key]
+            cotan[key] = prev + val if create_graph else jnp.add(prev, val)
         else:
             cotan[key] = val
 
-    leaf_by_id = {}
-
     for out, hg in zip(outputs, head_grads):
-        if getattr(out, "_tape_node", None) is None and not getattr(out, "_is_leaf", False):
+        if getattr(out, "_tape_node", None) is None and \
+                not getattr(out, "_is_leaf", False):
             raise ValueError(
                 "cannot differentiate output: it was not computed inside "
-                "autograd.record() (reference: mxnet.autograd same contract)"
-            )
-        g = hg._data if hasattr(hg, "_data") else hg
-        if g is None:
-            # MXNet defaults the head gradient to ones (autograd.py backward)
-            g = jnp.ones(out.shape, out._data.dtype)
+                "autograd.record() (reference: mxnet.autograd same contract)")
+        g = hg if hg is not None else \
+            _wrap(jnp.ones(out.shape, out._data.dtype))
+        if create_graph and not hasattr(g, "_data"):
+            g = _wrap(g)
+        elif not create_graph and hasattr(g, "_data"):
+            g = g._data
         _acc(_key(out), g)
         if getattr(out, "_is_leaf", False):
             leaf_by_id[id(out)] = out
 
-    order = _toposort(outputs)
-
-    for node in order:
-        out_cts = tuple(cotan.get((id(node), i)) for i in range(len(node.outputs)))
+    for node in _toposort(outputs):
+        out_cts = [cotan.get((id(node), i))
+                   for i in range(len(node.outputs))]
         if all(c is None for c in out_cts):
             continue
-        # fill zeros for missing output cotangents (vjp needs full tuple)
+        # fill zeros for missing output cotangents (vjp needs a full tuple)
         filled = []
         for arr, c in zip(node.outputs, out_cts):
             if c is None:
-                filled.append(jnp.zeros(arr.shape, arr._data.dtype))
+                z = jnp.zeros(arr.shape, arr._data.dtype)
+                filled.append(_wrap(z) if create_graph else z)
             else:
                 filled.append(c)
-        in_cts = node.vjp_fn(tuple(filled))
+        if create_graph and node.primal_fn is not None:
+            in_cts = _recorded_node_backward(node, filled)
+        else:
+            raw = tuple(f._data if hasattr(f, "_data") else f
+                        for f in filled)
+            in_cts = node.vjp_fn(raw)
+            if create_graph:
+                # opaque node (user Function / cached graph): values are
+                # correct but the second-order chain detaches here
+                in_cts = [None if c is None else _wrap(c) for c in in_cts]
         if len(in_cts) != len(node.inputs):
             raise RuntimeError(
                 "vjp for %s returned %d cotangents for %d inputs"
-                % (node.name, len(in_cts), len(node.inputs))
-            )
+                % (node.name, len(in_cts), len(node.inputs)))
         for inp, ct in zip(node.inputs, in_cts):
             if ct is None:
                 continue
@@ -195,11 +223,22 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
                 leaf_by_id[id(inp)] = inp
                 _acc(("leaf", id(inp)), ct)
             elif getattr(inp, "_tape_node", None) is not None:
-                _acc((id(inp._tape_node), inp._tape_index), ct)
-        if not retain_graph:
+                _acc(_key(inp), ct)
+        if not (retain_graph or create_graph):
             node.vjp_fn = _freed_vjp(node.name)
+    return cotan, leaf_by_id
 
-    # write grads into leaves
+
+def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse accumulation from ``outputs``.
+
+    Populates ``arr._grad`` on every reachable leaf marked via
+    ``mark_variable`` (i.e. ``attach_grad``), honoring grad_req write/add.
+    """
+    import jax.numpy as jnp
+
+    cotan, leaf_by_id = _reverse_walk(outputs, head_grads, retain_graph,
+                                      create_graph=False)
     for arr in leaf_by_id.values():
         g = cotan.get(("leaf", id(arr)))
         if g is None:
@@ -220,3 +259,79 @@ def _freed_vjp(name):
         )
 
     return _raise
+
+
+def _recorded_node_backward(node, filled_cts):
+    """Apply one node's backward AS A RECORDED OP (create_graph path).
+
+    Builds ``bwd(primals..., cotangents...) -> input_cotangents`` from the
+    node's primal function, executes it, and records the application as a
+    new tape node — its own vjp (via jax.vjp of bwd) differentiates through
+    both the residuals and the cotangents, which is exactly what second-
+    order gradients need.  Returns the input cotangents as NDArrays.
+    """
+    import jax
+    import jax.numpy as jnp
+    from .ndarray.ndarray import _wrap
+
+    n_primal = len(node.inputs)
+    primal_fn = node.primal_fn
+    multi = node.primal_multi
+    primal_dtypes = [inp._data.dtype for inp in node.inputs]
+
+    def bwd(*args):
+        primals, cts = args[:n_primal], args[n_primal:]
+        _, vjp = jax.vjp(primal_fn, *primals)
+        in_cts = vjp(tuple(cts) if multi else cts[0])
+        # keep output arity/dtypes stable for jax.vjp over bwd itself:
+        # float0 (int inputs) becomes a zeros placeholder
+        return tuple(
+            jnp.zeros(jnp.shape(p), jnp.float32)
+            if getattr(c, "dtype", None) == jax.dtypes.float0 else c
+            for c, p in zip(in_cts, args[:n_primal]))
+
+    arg_vals = [inp._data for inp in node.inputs] + \
+        [c._data for c in filled_cts]
+    out_vals, vjp2 = jax.vjp(bwd, *arg_vals)
+    outs = [_wrap(v) for v in out_vals]
+
+    def vjp_fn(cotangents, _vjp=vjp2):
+        in_cts = _vjp(tuple(cotangents))
+        return tuple(None if getattr(c, "dtype", None) == jax.dtypes.float0
+                     else c for c in in_cts)
+
+    record_node(list(node.inputs) + list(filled_cts), outs, vjp_fn,
+                name=node.name + "_backward", primal_fn=bwd,
+                primal_multi=True)
+    # int-dtype inputs get no gradient
+    return [None if not jnp.issubdtype(dt, jnp.inexact) else o
+            for o, dt in zip(outs, primal_dtypes)]
+
+
+def grad_arrays(outputs, variables, head_grads=None, retain_graph=False,
+                create_graph=False):
+    """Reverse accumulation returning cotangents for ``variables`` directly.
+
+    With ``create_graph=True`` every backward computation is itself recorded
+    on the tape (accumulating adds included), so the returned NDArrays can be
+    differentiated again — the TPU-native analog of the reference's
+    ``MXAutogradBackwardEx(create_graph=1)``.  Nodes recorded without a
+    primal function (user autograd.Function, cached hybrid graphs) fall back
+    to their opaque vjp and DETACH the second-order chain at that point.
+    """
+    from .ndarray.ndarray import _wrap
+
+    variables = list(variables)
+    prev_rec = set_recording(True) if create_graph else None
+    try:
+        cotan, _ = _reverse_walk(outputs, head_grads, retain_graph,
+                                 create_graph)
+    finally:
+        if prev_rec is not None:
+            set_recording(prev_rec)
+    results = []
+    for v in variables:
+        ct = cotan.get(("leaf", id(v)))
+        results.append(None if ct is None
+                       else (ct if hasattr(ct, "_data") else _wrap(ct)))
+    return results

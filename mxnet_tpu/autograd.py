@@ -79,26 +79,31 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Return gradients of heads w.r.t. variables (without touching .grad)."""
+    """Return gradients of heads w.r.t. variables (without touching .grad).
+
+    With ``create_graph=True`` the returned NDArrays are themselves on the
+    tape, so they can be differentiated again (grad-of-grad; reference
+    contract tests/python/unittest/test_higher_order_grad.py).  Without it
+    the results are detached: re-recording on them treats them as constants
+    w.r.t. the original inputs — use create_graph=True when a second-order
+    gradient is wanted.
+    """
     if isinstance(heads, NDArray):
         heads = [heads]
     if isinstance(variables, NDArray):
         variables = [variables]
-    # save/restore existing grad state on the variables
-    saved = [(v._grad, v._grad_req, v._is_leaf) for v in variables]
-    import jax.numpy as jnp
+    if head_grads is not None and isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
     for v in variables:
         if not v._is_leaf:
             raise ValueError("variables passed to grad() must have attach_grad() "
                              "called or be marked variables")
-        v._grad = _wrap(jnp.zeros(v.shape, v.dtype))
-        v._grad_req = "write"
-    _tape.backward(heads, head_grads if head_grads is None else list(head_grads),
-                   retain_graph if retain_graph is not None else create_graph,
-                   train_mode)
-    outs = [v._grad for v in variables]
-    for v, (g, r, l) in zip(variables, saved):
-        v._grad, v._grad_req, v._is_leaf = g, r, l
+    retain = retain_graph if retain_graph is not None else create_graph
+    outs = _tape.grad_arrays(heads, variables, head_grads,
+                             retain_graph=retain, create_graph=create_graph)
+    import jax.numpy as jnp
+    outs = [o if o is not None else _wrap(jnp.zeros(v.shape, v.dtype))
+            for o, v in zip(outs, variables)]
     return outs
 
 

@@ -1,14 +1,25 @@
 """Recurrent cells — per-step RNN building blocks.
 
-Reference: ``python/mxnet/gluon/rnn/rnn_cell.py`` — RecurrentCell base with
-begin_state/unroll, RNNCell/LSTMCell/GRUCell, Sequential/Bidirectional/
-Dropout/Zoneout/Residual modifiers.  ``unroll`` is a static Python loop —
-under hybridize/jit XLA sees a fully unrolled graph, matching how the
-reference's foreach/unroll builds per-step subgraphs.
+Capability parity with ``python/mxnet/gluon/rnn/rnn_cell.py`` (RecurrentCell
+base with begin_state/unroll, RNN/LSTM/GRU cells, Sequential/Bidirectional/
+Dropout/Zoneout/Residual modifiers), re-designed around the same fused-gate
+formulation as the scan-based fused op (``mxnet_tpu/ops/rnn.py``):
+
+* every cell computes ONE projection ``x·Wiᵀ + h·Whᵀ + b`` covering all
+  gates (a single MXU matmul pair per step), then carves gates out of it —
+  there is no per-gate FullyConnected chain and no per-step op naming;
+* ``unroll`` is a static Python loop over a step list, so under
+  hybridize/jit XLA sees a fully unrolled graph; variable-length sequences
+  are handled *inside* the loop with arithmetic keep-masks (state freezing
+  + output zeroing per step) rather than by post-hoc SequenceMask/
+  SequenceLast passes;
+* bidirectional unrolling reverses the padded sequence per-example with
+  ``SequenceReverse(use_sequence_length=True)`` so the backward direction
+  reads real tokens first, not padding.
 """
 from __future__ import annotations
 
-from ... import ndarray as nd_module
+from ... import ndarray as F
 from ...ndarray.ndarray import NDArray
 from ..block import Block, HybridBlock
 from ..parameter import tensor_types
@@ -19,65 +30,62 @@ __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "BidirectionalCell"]
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+# --------------------------------------------------------------- sequences
+#
+# A sequence enters `unroll` either as one stacked array (time somewhere in
+# `layout`) or as a per-step list.  Internally everything runs on the step
+# list; these two helpers are the only place layout strings are interpreted.
+
+def _as_steps(inputs, layout):
+    """Normalize to ``(step_list, time_axis, batch_size)``.
+
+    Steps are rank-reduced slices along the time axis; for a step the batch
+    dimension is always leading, regardless of the input layout.
+    """
+    t_ax = layout.find("T")
+    if isinstance(inputs, tensor_types):
+        n_steps = inputs.shape[t_ax]
+        pieces = F.split(inputs, num_outputs=n_steps, axis=t_ax,
+                         squeeze_axis=False)
+        if n_steps == 1:
+            pieces = [pieces]
+        steps = [p.squeeze(axis=t_ax) for p in pieces]
+        return steps, t_ax, inputs.shape[layout.find("N")]
+    steps = list(inputs)
+    return steps, t_ax, steps[0].shape[0]
 
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+def _restack(steps, time_axis):
+    """Inverse of `_as_steps` for merged output."""
+    return F.stack(*steps, axis=time_axis)
 
 
-def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        begin_state = cell.begin_state(batch_size=batch_size,
-                                       func=nd_module.zeros)
-    return begin_state
+def _keep_mask(valid_length, t, like):
+    """Broadcastable bool: does example b still have a token at step t?"""
+    alive = valid_length > t                      # (B,)
+    return alive.reshape((-1,) + (1,) * (len(like.shape) - 1))
 
 
-def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    assert inputs is not None, \
-        "unroll(inputs=None) is only supported for HybridBlocks with symbol " \
-        "inputs in the reference; pass NDArray inputs here."
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
-    batch_size = 0
-    in_axis = in_layout.find("T") if in_layout is not None else axis
-    F = nd_module
-    if isinstance(inputs, NDArray):
-        batch_size = inputs.shape[batch_axis]
-        if merge is False:
-            assert length is None or length == inputs.shape[in_axis]
-            inputs = [i.squeeze(axis=in_axis) for i in
-                      nd_module.split(inputs, num_outputs=inputs.shape[in_axis],
-                                      axis=in_axis, squeeze_axis=False)]
-    else:
-        assert length is None or len(inputs) == length
-        batch_size = inputs[0].shape[batch_axis]
-        if merge is True:
-            inputs = [i.expand_dims(axis=axis) for i in inputs]
-            inputs = nd_module.concat(*inputs, dim=axis)
-            in_axis = axis
-    if isinstance(inputs, NDArray) and axis != in_axis:
-        inputs = inputs.swapaxes(axis, in_axis)
-    return inputs, axis, F, batch_size
+def _act_fn(name_or_block):
+    """Resolve an activation spec to an NDArray-level callable."""
+    if callable(name_or_block):
+        return name_or_block
+    table = {"tanh": F.tanh, "relu": F.relu, "sigmoid": F.sigmoid,
+             "softsign": F.softsign}
+    if name_or_block in table:
+        return table[name_or_block]
+    return lambda x: F.Activation(x, act_type=name_or_block)
 
 
-def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
-                                   merge):
-    assert valid_length is not None
-    if not isinstance(data, NDArray):
-        data = F.stack(*data, axis=time_axis)
-    outputs = F.SequenceMask(data, sequence_length=valid_length,
-                             use_sequence_length=True, axis=time_axis)
-    if not merge:
-        outputs = [o.squeeze(axis=time_axis) for o in
-                   F.split(outputs, num_outputs=data.shape[time_axis],
-                           axis=time_axis, squeeze_axis=False)]
-    return outputs
-
+# ------------------------------------------------------------------- bases
 
 class RecurrentCell(Block):
-    """Abstract base class for RNN cells (reference: rnn_cell.py:81)."""
+    """Abstract per-step recurrent unit.
+
+    Capability contract (reference rnn_cell.py:81): `state_info`,
+    `begin_state`, `__call__(x_t, states) -> (out, new_states)`, and
+    `unroll` over a sequence.
+    """
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -85,446 +93,370 @@ class RecurrentCell(Block):
         self.reset()
 
     def reset(self):
-        """Reset before re-using the cell for another graph."""
-        self._init_counter = -1
+        """Clear per-unroll bookkeeping so the cell can run a new sequence."""
         self._counter = -1
-        for cell in self._children.values():
-            cell.reset()
+        self._init_counter = -1
+        for child in self._children.values():
+            child.reset()
 
     def state_info(self, batch_size=0):
         raise NotImplementedError()
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        """Initial state for this cell (reference: rnn_cell.py:119)."""
-        assert not self._modified, \
-            "After applying modifier cells (e.g. ZoneoutCell) the base " \
-            "cell cannot be called directly. Call the modifier cell instead."
-        if func is None:
-            func = nd_module.zeros
+        """Build the step-0 state list from `state_info`."""
+        if self._modified:
+            raise RuntimeError(
+                "cell %s was wrapped by a modifier (Zoneout/Residual/...); "
+                "request begin_state from the wrapper" % self.name)
+        make = func if func is not None else F.zeros
         states = []
         for info in self.state_info(batch_size):
             self._init_counter += 1
-            if info is not None:
-                info.update(kwargs)
-            else:
-                info = kwargs
-            state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                    self._init_counter),
-                         **info) if _accepts_name(func) else func(**info)
-            states.append(state)
+            spec = dict(info or {})
+            spec.pop("__layout__", None)
+            spec.update(kwargs)
+            states.append(make(**spec))
         return states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        """Unrolls the cell for `length` timesteps
-        (reference: rnn_cell.py:157)."""
-        self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
-                                                       False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs, batch_size)
-        states = begin_state
-        outputs = []
-        all_states = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-            if valid_length is not None:
-                all_states.append(states)
-        if valid_length is not None:
-            states = [F.SequenceLast(F.stack(*ele_list, axis=0),
-                                     sequence_length=valid_length,
-                                     use_sequence_length=True, axis=0)
-                      for ele_list in zip(*all_states)]
-            outputs = _mask_sequence_variable_length(F, outputs, length,
-                                                     valid_length, axis, True)
-        if merge_outputs:
-            if isinstance(outputs, (list, tuple)):
-                outputs = [o.expand_dims(axis=axis) for o in outputs]
-                outputs = F.concat(*outputs, dim=axis)
-        elif merge_outputs is None:
-            pass
-        return outputs, states
+        """Run the cell over a whole sequence.
 
-    def _get_activation(self, F, inputs, activation, **kwargs):
-        func = {"tanh": F.tanh, "relu": F.relu, "sigmoid": F.sigmoid,
-                "softsign": F.softsign}.get(activation)
-        if func:
-            return func(inputs, **kwargs)
-        if isinstance(activation, str):
-            return F.Activation(inputs, act_type=activation, **kwargs)
-        if isinstance(activation, HybridBlock):
-            return activation(inputs, **kwargs)
-        return activation(inputs, **kwargs)
+        With `valid_length`, masking happens inside the loop: once step t
+        passes a sequence's end its output is zeroed and its state frozen,
+        which makes the returned states exactly the last-valid-step states
+        (the arithmetic equivalent of the reference's SequenceLast).
+        """
+        self.reset()
+        steps, t_ax, batch = _as_steps(inputs, layout)
+        if length is not None and len(steps) != length:
+            raise ValueError("unroll length %d != sequence length %d"
+                             % (length, len(steps)))
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch_size=batch)
+        outs = []
+        for t, x_t in enumerate(steps):
+            y, stepped = self(x_t, states)
+            if valid_length is not None:
+                y = F.where(_keep_mask(valid_length, t, y),
+                            y, F.zeros_like(y))
+                states = [F.where(_keep_mask(valid_length, t, ns), ns, s)
+                          for ns, s in zip(stepped, states)]
+            else:
+                states = stepped
+            outs.append(y)
+        if merge_outputs:
+            return _restack(outs, t_ax), states
+        return outs, states
 
     def forward(self, inputs, states):
-        """One-step forward (reference: rnn_cell.py:260)."""
         self._counter += 1
         return super().forward(inputs, states)
 
 
-def _accepts_name(func):
-    import inspect
-    try:
-        sig = inspect.signature(func)
-        return "name" in sig.parameters or any(
-            p.kind == inspect.Parameter.VAR_KEYWORD
-            for p in sig.parameters.values())
-    except (TypeError, ValueError):
-        return False
-
-
 class HybridRecurrentCell(RecurrentCell, HybridBlock):
-    """RecurrentCell with hybrid_forward (reference: rnn_cell.py:270)."""
+    """RecurrentCell whose step is expressed via hybrid_forward."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
 
     def forward(self, inputs, states):
         self._counter += 1
-        # bypass HybridBlock.forward's single-x signature: run eager/hybrid
-        # machinery with two positional args
-        if self._active:
-            if self._cached_graph_obj is None:
-                out = self._eager_forward(inputs, states)
-                from ..block import _CachedGraph
-                self._cached_graph_obj = _CachedGraph(self)
-                return out
-            # states is a list: flatten through the cached call
-            return self._eager_forward(inputs, states)
+        # two-positional-arg step: run the eager/hybrid machinery directly
+        if self._active and self._cached_graph_obj is None:
+            out = self._eager_forward(inputs, states)
+            from ..block import _CachedGraph
+            self._cached_graph_obj = _CachedGraph(self)
+            return out
         return self._eager_forward(inputs, states)
 
     def _eager_forward(self, inputs, states):
         params = self._get_params_nd(inputs)
-        return self.hybrid_forward(nd_module, inputs, states, **params)
+        return self.hybrid_forward(F, inputs, states, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
-class RNNCell(HybridRecurrentCell):
-    """Elman RNN cell: h' = act(W_i x + b_i + W_h h + b_h)
-    (reference: rnn_cell.py:300)."""
+# -------------------------------------------------------------- gate cells
+
+class _GatedCell(HybridRecurrentCell):
+    """Shared machinery for RNN/LSTM/GRU: fused projections + param setup.
+
+    Weight layout matches the fused RNN op (and cuDNN): i2h (G*H, in),
+    h2h (G*H, H), gate blocks stacked along rows.
+    """
+
+    _num_gates = 1
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        rows = self._num_gates * hidden_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(rows, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(rows, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(rows,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(rows,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._num_gates * self._hidden_size,
+                                 x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        one = {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}
+        return [dict(one) for _ in range(self._num_states)]
+
+    _num_states = 1
+
+    def _project(self, F, x, h, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        """All gates in one shot: (B, G*H)."""
+        return (F.dot(x, i2h_weight, transpose_b=True)
+                + F.dot(h, h2h_weight, transpose_b=True)
+                + i2h_bias + h2h_bias)
+
+    def _gates(self, z):
+        """Carve the fused projection into G (B, H) blocks."""
+        if self._num_gates == 1:
+            return (z,)
+        return tuple(F.split(z, num_outputs=self._num_gates, axis=1))
+
+    def __repr__(self):
+        shape = self.i2h_weight.shape
+        detail = "%s -> %s" % (shape[1] if shape[1] else None, shape[0])
+        extra = getattr(self, "_activation", None)
+        if isinstance(extra, str) and type(self) is RNNCell:
+            detail += ", %s" % extra
+        return "%s(%s)" % (self.__class__.__name__, detail)
+
+
+class RNNCell(_GatedCell):
+    """Elman step: h' = act(x·Wiᵀ + h·Whᵀ + bi + bh)."""
+
+    _num_gates = 1
+    _num_states = 1
 
     def __init__(self, hidden_size, activation="tanh",
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  input_size=0, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
+        # positional order matches the reference API (rnn_cell.py:300)
+        super().__init__(hidden_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, input_size, prefix, params)
         self._activation = activation
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
-            allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
-            allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
 
     def _alias(self):
         return "rnn"
 
-    def infer_shape(self, x, *args):
-        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
-
-    def __repr__(self):
-        s = "{name}({mapping}"
-        if hasattr(self, "_activation"):
-            s += ", {_activation}"
-        s += ")"
-        shape = self.i2h_weight.shape
-        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None, shape[0])
-        return s.format(name=self.__class__.__name__, mapping=mapping,
-                        **self.__dict__)
-
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prefix = "t%d_" % self._counter
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size,
-                               name=prefix + "h2h")
-        i2h_plus_h2h = i2h + h2h
-        output = self._get_activation(F, i2h_plus_h2h, self._activation,
-                                      name=prefix + "out")
-        return output, [output]
+        z = self._project(F, inputs, states[0], i2h_weight, h2h_weight,
+                          i2h_bias, h2h_bias)
+        h = _act_fn(self._activation)(z)
+        return h, [h]
 
 
-class LSTMCell(HybridRecurrentCell):
-    """LSTM cell (reference: rnn_cell.py:398)."""
+class LSTMCell(_GatedCell):
+    """LSTM step, gate rows ordered i, f, c̃, o (cuDNN order).
+
+    c' = σ(f)·c + σ(i)·act(c̃);  h' = σ(o)·act(c')
+    """
+
+    _num_gates = 4
+    _num_states = 2
 
     def __init__(self, hidden_size, i2h_weight_initializer=None,
                  h2h_weight_initializer=None, i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", input_size=0, prefix=None,
                  params=None, activation="tanh",
                  recurrent_activation="sigmoid"):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer,
-            allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer,
-            allow_deferred_init=True)
+        # positional order matches the reference API (rnn_cell.py:398)
+        super().__init__(hidden_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, input_size, prefix, params)
         self._activation = activation
         self._recurrent_activation = recurrent_activation
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
-                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
 
     def _alias(self):
         return "lstm"
 
-    def infer_shape(self, x, *args):
-        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
-
-    def __repr__(self):
-        s = "{name}({mapping})"
-        shape = self.i2h_weight.shape
-        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None, shape[0])
-        return s.format(name=self.__class__.__name__, mapping=mapping)
-
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prefix = "t%d_" % self._counter
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=4 * self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=4 * self._hidden_size,
-                               name=prefix + "h2h")
-        gates = i2h + h2h
-        slice_gates = F.split(gates, num_outputs=4, axis=1,
-                              squeeze_axis=False)
-        in_gate = self._get_activation(F, slice_gates[0],
-                                       self._recurrent_activation,
-                                       name=prefix + "i")
-        forget_gate = self._get_activation(F, slice_gates[1],
-                                           self._recurrent_activation,
-                                           name=prefix + "f")
-        in_transform = self._get_activation(F, slice_gates[2],
-                                            self._activation,
-                                            name=prefix + "c")
-        out_gate = self._get_activation(F, slice_gates[3],
-                                        self._recurrent_activation,
-                                        name=prefix + "o")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * self._get_activation(F, next_c, self._activation,
-                                                 name=prefix + "state")
-        return next_h, [next_h, next_c]
+        h_prev, c_prev = states
+        act = _act_fn(self._activation)
+        gate = _act_fn(self._recurrent_activation)
+        z = self._project(F, inputs, h_prev, i2h_weight, h2h_weight,
+                          i2h_bias, h2h_bias)
+        zi, zf, zc, zo = self._gates(z)
+        c = gate(zf) * c_prev + gate(zi) * act(zc)
+        h = gate(zo) * act(c)
+        return h, [h, c]
 
 
-class GRUCell(HybridRecurrentCell):
-    """GRU cell with cuDNN gate order r,z,n (reference: rnn_cell.py:525)."""
+class GRUCell(_GatedCell):
+    """GRU step, gate rows ordered r, z, n (cuDNN order).
+
+    n = tanh(xn + r·hn)  with the reset gate applied to the *hidden*
+    projection only, so the input and hidden halves of the n-gate must stay
+    separate — the one place the fused projection is computed as two parts.
+    """
+
+    _num_gates = 3
+    _num_states = 1
 
     def __init__(self, hidden_size, i2h_weight_initializer=None,
                  h2h_weight_initializer=None, i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", input_size=0, prefix=None,
                  params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,), init=i2h_bias_initializer,
-            allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,), init=h2h_bias_initializer,
-            allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+        # positional order matches the reference API (rnn_cell.py:525)
+        super().__init__(hidden_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, input_size, prefix, params)
 
     def _alias(self):
         return "gru"
 
-    def infer_shape(self, x, *args):
-        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
-
-    def __repr__(self):
-        s = "{name}({mapping})"
-        shape = self.i2h_weight.shape
-        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None, shape[0])
-        return s.format(name=self.__class__.__name__, mapping=mapping)
-
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prefix = "t%d_" % self._counter
-        prev_state_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=3 * self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
-                               num_hidden=3 * self._hidden_size,
-                               name=prefix + "h2h")
-        i2h_r, i2h_z, i2h = F.split(i2h, num_outputs=3, axis=1,
-                                    squeeze_axis=False)
-        h2h_r, h2h_z, h2h = F.split(h2h, num_outputs=3, axis=1,
-                                    squeeze_axis=False)
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                  name=prefix + "r_act")
-        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                   name=prefix + "z_act")
-        next_h_tmp = F.Activation(i2h + reset_gate * h2h, act_type="tanh",
-                                  name=prefix + "h_act")
-        ones = F.ones_like(update_gate)
-        next_h = (ones - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+        h_prev = states[0]
+        xz = F.dot(inputs, i2h_weight, transpose_b=True) + i2h_bias
+        hz = F.dot(h_prev, h2h_weight, transpose_b=True) + h2h_bias
+        xr, xu, xn = F.split(xz, num_outputs=3, axis=1)
+        hr, hu, hn = F.split(hz, num_outputs=3, axis=1)
+        reset = F.sigmoid(xr + hr)
+        update = F.sigmoid(xu + hu)
+        cand = F.tanh(xn + reset * hn)
+        h = update * h_prev + (1 - update) * cand
+        return h, [h]
 
 
-class SequentialRNNCell(RecurrentCell):
-    """Sequentially stacking multiple RNN cells
-    (reference: rnn_cell.py:655)."""
+# ------------------------------------------------------------------ stacks
 
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        return s.format(name=self.__class__.__name__,
-                        modstr="\n".join(
-                            ["({i}): {m}".format(i=i, m=str(m).replace("\n", "\n  "))
-                             for i, m in self._children.items()]))
+class _CellStack:
+    """State routing shared by the two sequential containers: a flat state
+    list is carved per child by each child's own state arity."""
 
     def add(self, cell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(**kwargs))
+        return states
 
-    def __call__(self, inputs, states):
-        self._counter += 1
-        next_states = []
-        p = 0
-        assert all(not isinstance(cell, BidirectionalCell)
-                   for cell in self._children.values())
+    def _carve_states(self, states):
+        """Yield (cell, its slice of the flat state list)."""
+        at = 0
         for cell in self._children.values():
             n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+            yield cell, states[at:at + n]
+            at += n
 
-    def unroll(self, length, inputs, begin_state=None, layout="NTC",
-               merge_outputs=None, valid_length=None):
+    def _stacked_call(self, inputs, states):
+        self._counter += 1
+        out_states = []
+        for cell, sub in self._carve_states(states):
+            if isinstance(cell, BidirectionalCell):
+                raise TypeError("BidirectionalCell cannot be stepped; "
+                                "use unroll")
+            inputs, sub = cell(inputs, sub)
+            out_states.extend(sub)
+        return inputs, out_states
+
+    def _stacked_unroll(self, length, inputs, begin_state, layout,
+                        merge_outputs, valid_length):
+        """Layer-by-layer unroll so per-cell unroll specializations
+        (DropoutCell's whole-sequence fast path) apply."""
         self.reset()
-        inputs, _, F, batch_size = _format_sequence(length, inputs, layout,
-                                                    None)
-        num_cells = len(self._children)
-        begin_state = _get_begin_state(self, F, begin_state, inputs, batch_size)
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._children.values()):
-            n = len(cell.state_info())
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+        steps, _, batch = _as_steps(inputs, layout)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch_size=batch)
+        seq = steps
+        out_states = []
+        cells = list(self._children.values())
+        for k, (cell, sub) in enumerate(self._carve_states(states)):
+            last = k == len(cells) - 1
+            seq, sub = cell.unroll(
+                length, seq, begin_state=sub, layout=layout,
+                merge_outputs=merge_outputs if last else None,
                 valid_length=valid_length)
-            next_states.extend(states)
-        return inputs, next_states
+            out_states.extend(sub)
+        return seq, out_states
 
     def __getitem__(self, i):
         return list(self._children.values())[i]
 
     def __len__(self):
         return len(self._children)
+
+    def __repr__(self):
+        body = "\n".join("(%s): %s" % (i, str(m).replace("\n", "\n  "))
+                         for i, m in self._children.items())
+        return "%s(\n%s\n)" % (self.__class__.__name__, body)
+
+
+class SequentialRNNCell(_CellStack, RecurrentCell):
+    """Stack of cells applied in order each step."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def __call__(self, inputs, states):
+        return self._stacked_call(inputs, states)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        return self._stacked_unroll(length, inputs, begin_state, layout,
+                                    merge_outputs, valid_length)
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError
 
 
-class HybridSequentialRNNCell(HybridRecurrentCell):
-    """Sequentially stacking multiple HybridRNN cells
-    (reference: rnn_cell.py:740)."""
+class HybridSequentialRNNCell(_CellStack, HybridRecurrentCell):
+    """Stack of hybrid cells applied in order each step."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
 
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        return s.format(name=self.__class__.__name__,
-                        modstr="\n".join(
-                            ["({i}): {m}".format(i=i, m=str(m).replace("\n", "\n  "))
-                             for i, m in self._children.items()]))
-
-    def add(self, cell):
-        self.register_child(cell)
-
-    def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
-
     def __call__(self, inputs, states):
-        self._counter += 1
-        next_states = []
-        p = 0
-        assert all(not isinstance(cell, BidirectionalCell)
-                   for cell in self._children.values())
-        for cell in self._children.values():
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        return self._stacked_call(inputs, states)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        return SequentialRNNCell.unroll(self, length, inputs, begin_state,
-                                        layout, merge_outputs, valid_length)
+        return self._stacked_unroll(length, inputs, begin_state, layout,
+                                    merge_outputs, valid_length)
 
-    def __getitem__(self, i):
-        return list(self._children.values())[i]
 
-    def __len__(self):
-        return len(self._children)
-
+# --------------------------------------------------------------- modifiers
 
 class DropoutCell(HybridRecurrentCell):
-    """Applies dropout on input (reference: rnn_cell.py:811)."""
+    """Stateless cell applying dropout to its input."""
 
     def __init__(self, rate, axes=(), prefix=None, params=None):
         super().__init__(prefix, params)
-        assert isinstance(rate, float)
-        self._rate = rate
+        self._rate = float(rate)
         self._axes = axes
-
-    def __repr__(self):
-        return "{name}(rate={_rate}, axes={_axes})".format(
-            name=self.__class__.__name__, **self.__dict__)
 
     def state_info(self, batch_size=0):
         return []
@@ -536,33 +468,36 @@ class DropoutCell(HybridRecurrentCell):
         from ... import autograd
         if self._rate > 0:
             inputs = F.Dropout(inputs, p=self._rate, axes=self._axes,
-                               name="t%d_fwd" % self._counter,
                                training=autograd.is_training())
         return inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
+        # dropout needs no recurrence: a merged input can be masked in one
+        # whole-sequence op instead of per step — but only when the caller
+        # didn't ask for a per-step list back
         self.reset()
-        inputs, axis, F, _ = _format_sequence(length, inputs, layout,
-                                              merge_outputs)
-        if isinstance(inputs, tensor_types):
-            return self.hybrid_forward(F, inputs, begin_state if begin_state
-                                       else [])
+        if isinstance(inputs, tensor_types) and merge_outputs is not False:
+            return self.hybrid_forward(F, inputs,
+                                       begin_state if begin_state else [])
         return super().unroll(length, inputs, begin_state=begin_state,
                               layout=layout, merge_outputs=merge_outputs,
                               valid_length=valid_length)
 
+    def __repr__(self):
+        return "%s(rate=%s, axes=%s)" % (self.__class__.__name__,
+                                         self._rate, self._axes)
+
 
 class ModifierCell(HybridRecurrentCell):
-    """Base class for modifier cells (reference: rnn_cell.py:878)."""
+    """Wraps another cell, borrowing its parameters and state layout."""
 
     def __init__(self, base_cell):
-        assert not base_cell._modified, \
-            "Cell %s is already modified. One cell cannot be modified twice" \
-            % base_cell.name
+        if base_cell._modified:
+            raise ValueError("cell %s already has a modifier attached"
+                             % base_cell.name)
         base_cell._modified = True
-        super().__init__(prefix=base_cell.prefix + self._alias(),
-                         params=None)
+        super().__init__(prefix=base_cell.prefix + self._alias(), params=None)
         self.base_cell = base_cell
 
     @property
@@ -574,40 +509,32 @@ class ModifierCell(HybridRecurrentCell):
 
     def begin_state(self, func=None, **kwargs):
         assert not self._modified
+        # temporarily lift the guard so the wrapped cell can answer
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(func=func, **kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError
 
     def __repr__(self):
-        return "{name}({base_cell})".format(name=self.__class__.__name__,
-                                            base_cell=self.base_cell)
+        return "%s(%s)" % (self.__class__.__name__, self.base_cell)
 
 
 class ZoneoutCell(ModifierCell):
-    """Applies Zoneout on base cell (reference: rnn_cell.py:940)."""
+    """Zoneout: randomly keep previous outputs/states instead of new ones
+    (Krueger et al. 2016)."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout since it doesn't " \
-            "support step. Please add ZoneoutCell to the cells underneath " \
-            "instead."
-        assert not isinstance(base_cell, SequentialRNNCell) or \
-            not getattr(base_cell, "_bidirectional", False), \
-            "Bidirectional SequentialRNNCell doesn't support zoneout. " \
-            "Please add ZoneoutCell to the cells underneath instead."
+        if isinstance(base_cell, BidirectionalCell):
+            raise TypeError("zoneout cannot wrap a BidirectionalCell "
+                            "(it has no single step); wrap the inner cells")
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
         self._prev_output = None
-
-    def __repr__(self):
-        return "{name}(p_out={zoneout_outputs}, p_state={zoneout_states}, " \
-            "{base_cell})".format(name=self.__class__.__name__,
-                                  **self.__dict__)
 
     def _alias(self):
         return "zoneout"
@@ -617,60 +544,66 @@ class ZoneoutCell(ModifierCell):
         self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
-        mask = (lambda p, like: F.Dropout(F.ones_like(like), p=p,
-                                          training=True))
-        prev_output = self._prev_output
-        if prev_output is None:
-            prev_output = F.zeros_like(next_output)
-        output = (F.where(mask(p_outputs, next_output), next_output,
-                          prev_output)
-                  if p_outputs != 0.0 else next_output)
-        new_states = ([F.where(mask(p_states, new_s), new_s, old_s)
-                       for new_s, old_s in zip(next_states, states)]
-                      if p_states != 0.0 else next_states)
-        self._prev_output = output
-        return output, new_states
+        p_out, p_state = self.zoneout_outputs, self.zoneout_states
+        new_out, new_states = self.base_cell(inputs, states)
+
+        def keep_new(p, new, old):
+            # draw a keep-mask via dropout-of-ones: nonzero -> take new
+            flip = F.Dropout(F.ones_like(new), p=p, training=True)
+            return F.where(flip, new, old)
+
+        prev = self._prev_output
+        if prev is None:
+            prev = F.zeros_like(new_out)
+        out = keep_new(p_out, new_out, prev) if p_out else new_out
+        states_out = ([keep_new(p_state, n, o)
+                       for n, o in zip(new_states, states)]
+                      if p_state else new_states)
+        self._prev_output = out
+        return out, states_out
+
+    def __repr__(self):
+        return "%s(p_out=%s, p_state=%s, %s)" % (
+            self.__class__.__name__, self.zoneout_outputs,
+            self.zoneout_states, self.base_cell)
 
 
 class ResidualCell(ModifierCell):
-    """Adds residual connection to base cell (reference: rnn_cell.py:1003)."""
+    """Adds the input back onto the wrapped cell's output."""
 
     def __init__(self, base_cell):
         super().__init__(base_cell)
 
     def hybrid_forward(self, F, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         self.reset()
         self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=merge_outputs, valid_length=valid_length)
-        self.base_cell._modified = True
-        merge_outputs = (isinstance(outputs, tensor_types)
-                         if merge_outputs is None else merge_outputs)
-        inputs, axis, F, _ = _format_sequence(length, inputs, layout,
-                                              merge_outputs)
+        try:
+            outs, states = self.base_cell.unroll(
+                length, inputs, begin_state=begin_state, layout=layout,
+                merge_outputs=merge_outputs, valid_length=valid_length)
+        finally:
+            self.base_cell._modified = True
+        merged = isinstance(outs, tensor_types) if merge_outputs is None \
+            else merge_outputs
+        steps, t_ax, _ = _as_steps(inputs, layout)
         if valid_length is not None:
-            inputs = _mask_sequence_variable_length(F, inputs, length,
-                                                    valid_length, axis,
-                                                    merge_outputs)
-        if merge_outputs:
-            outputs = outputs + inputs
-        else:
-            outputs = [i + j for i, j in zip(outputs, inputs)]
-        return outputs, states
+            steps = [F.where(_keep_mask(valid_length, t, s), s,
+                             F.zeros_like(s))
+                     for t, s in enumerate(steps)]
+        if merged:
+            return outs + _restack(steps, t_ax), states
+        return [o + s for o, s in zip(outs, steps)], states
 
 
 class BidirectionalCell(HybridRecurrentCell):
-    """Bidirectional RNN cell for unrolling (reference: rnn_cell.py:1055)."""
+    """Runs one cell forward and one backward over the sequence; outputs
+    are per-step concatenations.  Unroll-only (a single step has no
+    meaning for the backward direction)."""
 
     def __init__(self, l_cell, r_cell, output_prefix="bi_"):
         super().__init__(prefix="", params=None)
@@ -680,49 +613,53 @@ class BidirectionalCell(HybridRecurrentCell):
 
     def __call__(self, inputs, states):
         raise NotImplementedError(
-            "Bidirectional cannot be stepped. Please use unroll")
-
-    def __repr__(self):
-        return "{name}(forward={l_cell}, backward={r_cell})".format(
-            name=self.__class__.__name__,
-            l_cell=self._children["l_cell"],
-            r_cell=self._children["r_cell"])
+            "BidirectionalCell cannot be stepped; use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return (self._children["l_cell"].begin_state(**kwargs)
+                + self._children["r_cell"].begin_state(**kwargs))
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
-                                                       False)
-        reversed_inputs = list(reversed(inputs))
-        begin_state = _get_begin_state(self, F, begin_state, inputs, batch_size)
-        states = begin_state
-        l_cell, r_cell = self._children.values()
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs, begin_state=states[:len(l_cell.state_info())],
-            layout=layout, merge_outputs=False, valid_length=valid_length)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=reversed_inputs,
-            begin_state=states[len(l_cell.state_info()):], layout=layout,
+        steps, t_ax, batch = _as_steps(inputs, layout)
+        n_steps = len(steps)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch_size=batch)
+        fwd = self._children["l_cell"]
+        bwd = self._children["r_cell"]
+        split_at = len(fwd.state_info())
+
+        f_out, f_states = fwd.unroll(
+            n_steps, steps, begin_state=states[:split_at], layout=layout,
             merge_outputs=False, valid_length=valid_length)
-        if valid_length is not None:
-            r_outputs = _mask_sequence_variable_length(
-                F, F.stack(*list(reversed(r_outputs)), axis=axis), length,
-                valid_length, axis, True)
-            r_outputs = [o.squeeze(axis=axis) for o in F.split(
-                r_outputs, num_outputs=length, axis=axis, squeeze_axis=False)]
-        else:
-            r_outputs = list(reversed(r_outputs))
-        outputs = [F.concat(l_o, r_o, dim=1)
-                   for l_o, r_o in zip(l_outputs, r_outputs)]
+
+        # reverse per example so the backward cell starts at each
+        # sequence's real end, not at the padding
+        stacked = _restack(steps, 0)
+        rev = F.SequenceReverse(stacked, sequence_length=valid_length,
+                                use_sequence_length=valid_length is not None,
+                                axis=0)
+        rev_steps, _, _ = _as_steps(rev, "TNC")
+        b_out, b_states = bwd.unroll(
+            n_steps, rev_steps, begin_state=states[split_at:], layout="TNC",
+            merge_outputs=False, valid_length=valid_length)
+        b_stacked = F.SequenceReverse(
+            _restack(b_out, 0), sequence_length=valid_length,
+            use_sequence_length=valid_length is not None, axis=0)
+        b_out, _, _ = _as_steps(b_stacked, "TNC")
+
+        outs = [F.concat(f, b, dim=1) for f, b in zip(f_out, b_out)]
         if merge_outputs:
-            outputs = [o.expand_dims(axis=axis) for o in outputs]
-            outputs = F.concat(*outputs, dim=axis)
-        states = l_states + r_states
-        return outputs, states
+            return _restack(outs, t_ax), f_states + b_states
+        return outs, f_states + b_states
+
+    def __repr__(self):
+        return "%s(forward=%s, backward=%s)" % (
+            self.__class__.__name__, self._children["l_cell"],
+            self._children["r_cell"])

@@ -1,12 +1,21 @@
 """Evaluation metrics.
 
-Reference: ``python/mxnet/metric.py`` — ``EvalMetric`` registry with
+Capability parity with ``python/mxnet/metric.py`` (EvalMetric registry:
 Accuracy/TopK/F1/MCC/MAE/MSE/RMSE/CrossEntropy/NLL/Pearson/Perplexity/
-Composite/Custom metrics, updated per batch by ``Module.update_metric`` or user
-loops.  Metric math runs on host numpy: metric updates are small reductions
-over already-materialized outputs, so keeping them off-device avoids recompiles
-and device syncs in the training hot loop (compute the network on TPU, reduce
-the scalar on host).
+Composite/Custom), re-designed around three pieces of shared machinery
+instead of the reference's per-class accumulation fields:
+
+* ``_Tally`` — one weighted-sum accumulator kept at two scopes (the
+  resettable local window and the whole run), replacing the duplicated
+  sum_metric/global_sum_metric bookkeeping;
+* ``_Confusion`` — binary confusion COUNTS as 2x2 matrices per scope;
+  precision/recall/F1/MCC are pure functions of a matrix;
+* ``EvalMetric.update`` iterates (label, pred) pairs once and defers the
+  per-pair math to ``_measure``, so most metrics are a single method.
+
+Metric math runs on host numpy: updates are small reductions over already
+materialized outputs, so keeping them off-device avoids recompiles and
+device syncs in the training hot loop.
 """
 from __future__ import annotations
 
@@ -38,49 +47,91 @@ def _alias(*names):
 
 
 def create(metric, *args, **kwargs):
-    """Create metric from name / callable / list / instance."""
+    """Create a metric from a name, callable, list, or instance."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+        bundle = CompositeEvalMetric()
+        for item in metric:
+            bundle.add(create(item, *args, **kwargs))
+        return bundle
     if isinstance(metric, str):
-        name = metric.lower()
-        if name not in _METRIC_REGISTRY:
-            raise ValueError("Metric must be either callable or in registry; "
-                             "got %s" % metric)
-        return _METRIC_REGISTRY[name](*args, **kwargs)
+        klass = _METRIC_REGISTRY.get(metric.lower())
+        if klass is None:
+            raise ValueError("unknown metric %r (registered: %s)"
+                             % (metric, sorted(_METRIC_REGISTRY)))
+        return klass(*args, **kwargs)
     raise TypeError("metric should be str, callable, list or EvalMetric")
 
 
-def _as_numpy(x):
-    if isinstance(x, NDArray):
-        return x.asnumpy()
-    return numpy.asarray(x)
+def _host(x):
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Validate that label/pred collections (or arrays) line up."""
+    a = labels.shape if shape else len(labels)
+    b = preds.shape if shape else len(preds)
+    if a != b:
+        raise ValueError("labels %s do not match predictions %s" % (a, b))
     if wrap:
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels = [labels] if isinstance(labels, NDArray) else labels
+        preds = [preds] if isinstance(preds, NDArray) else preds
     return labels, preds
 
 
+def _paired(labels, preds):
+    """Yield (label, pred) numpy pairs from parallel collections."""
+    if isinstance(labels, NDArray):
+        labels = [labels]
+    if isinstance(preds, NDArray):
+        preds = [preds]
+    if len(labels) != len(preds):
+        raise ValueError("got %d labels for %d predictions"
+                         % (len(labels), len(preds)))
+    for label, pred in zip(labels, preds):
+        yield _host(label), _host(pred)
+
+
+class _Tally:
+    """A weighted sum kept at two scopes: the resettable window ('local'
+    in the reference API) and the whole run ('global')."""
+
+    __slots__ = ("wsum", "n", "run_wsum", "run_n")
+
+    def __init__(self):
+        self.clear_all()
+
+    def add(self, value, weight):
+        self.wsum += value
+        self.n += weight
+        self.run_wsum += value
+        self.run_n += weight
+
+    def mean(self):
+        return self.wsum / self.n if self.n else float("nan")
+
+    def run_mean(self):
+        return self.run_wsum / self.run_n if self.run_n else float("nan")
+
+    def clear_window(self):
+        self.wsum = 0.0
+        self.n = 0
+
+    def clear_all(self):
+        self.wsum = 0.0
+        self.n = 0
+        self.run_wsum = 0.0
+        self.run_n = 0
+
+
 class EvalMetric:
-    """Base metric (reference: metric.py:43)."""
+    """Base metric.  Reference API surface (metric.py:43): update/
+    update_dict, get/get_global, get_name_value, reset/reset_local; the
+    accumulator behind it is a `_Tally` exposed through compatibility
+    properties (sum_metric & co.)."""
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
@@ -88,93 +139,114 @@ class EvalMetric:
         self.label_names = label_names
         self._has_global_stats = kwargs.pop("has_global_stats", False)
         self._kwargs = kwargs
+        self._tally = _Tally()
         self.reset()
 
+    # -- compatibility accessors onto the tally ---------------------------
+    @property
+    def sum_metric(self):
+        return self._tally.wsum
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        self._tally.wsum = v
+
+    @property
+    def num_inst(self):
+        return self._tally.n
+
+    @num_inst.setter
+    def num_inst(self, v):
+        self._tally.n = v
+
+    @property
+    def global_sum_metric(self):
+        return self._tally.run_wsum
+
+    @global_sum_metric.setter
+    def global_sum_metric(self, v):
+        self._tally.run_wsum = v
+
+    @property
+    def global_num_inst(self):
+        return self._tally.run_n
+
+    @global_num_inst.setter
+    def global_num_inst(self, v):
+        self._tally.run_n = v
+
+    # ---------------------------------------------------------------------
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
+        config = dict(self._kwargs)
+        config.update(metric=self.__class__.__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
         return config
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
+        pred = ([pred[n] for n in self.output_names]
+                if self.output_names is not None else list(pred.values()))
+        label = ([label[n] for n in self.label_names]
+                 if self.label_names is not None else list(label.values()))
         self.update(label, pred)
 
     def update(self, labels, preds):
+        """Default path: per-pair `_measure` -> weighted tally."""
+        for label, pred in _paired(labels, preds):
+            value, weight = self._measure(label, pred)
+            self._tally.add(value, weight)
+
+    def _measure(self, label, pred):
+        """Return (value_sum, weight) for one label/pred pair."""
         raise NotImplementedError()
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
-        self.global_num_inst = 0
-        self.global_sum_metric = 0.0
+        self._tally.clear_all()
 
     def reset_local(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self._tally.clear_window()
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._tally.mean())
 
     def get_global(self):
         if self._has_global_stats:
-            if self.global_num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.global_sum_metric / self.global_num_inst)
+            return (self.name, self._tally.run_mean())
         return self.get()
 
-    def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
+    @staticmethod
+    def _listify(pair):
+        name, value = pair
+        name = name if isinstance(name, list) else [name]
+        value = value if isinstance(value, list) else [value]
         return list(zip(name, value))
+
+    def get_name_value(self):
+        return self._listify(self.get())
 
     def get_global_name_value(self):
         if self._has_global_stats:
-            name, value = self.get_global()
-            if not isinstance(name, list):
-                name = [name]
-            if not isinstance(value, list):
-                value = [value]
-            return list(zip(name, value))
+            return self._listify(self.get_global())
         return self.get_name_value()
 
+    # kept for subclasses/backwards-compat with the reference's protected API
     def _update(self, metric, inst):
-        self.sum_metric += metric
-        self.num_inst += inst
-        self.global_sum_metric += metric
-        self.global_num_inst += inst
+        self._tally.add(metric, inst)
 
 
 @register
 @_alias("composite")
 class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics as one (reference: metric.py:369)."""
+    """Several metrics updated and reported together."""
 
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -183,71 +255,53 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+            return ValueError("metric index %d out of range [0, %d)"
+                              % (index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = {name: label for name, label in zip(self.label_names, labels)}
+            labels = dict(zip(self.label_names, labels))
         if self.output_names is not None:
-            preds = {name: pred for name, pred in zip(self.output_names, preds)}
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+            preds = dict(zip(self.output_names, preds))
+        for m in self.metrics:
+            m.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset()
 
     def reset_local(self):
-        try:
-            for metric in self.metrics:
-                metric.reset_local()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset_local()
+
+    def _collect(self, getter):
+        names, values = [], []
+        for m in self.metrics:
+            for n, v in self._listify(getter(m)):
+                names.append(n)
+                values.append(v)
+        return (names, values)
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._collect(lambda m: m.get())
 
     def get_global(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get_global()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._collect(lambda m: m.get_global())
 
     def get_config(self):
         config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        config["metrics"] = [m.get_config() for m in self.metrics]
         return config
 
 
 @register
 @_alias("acc")
 class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:493)."""
+    """Fraction of samples whose argmax prediction equals the label."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
@@ -255,265 +309,202 @@ class Accuracy(EvalMetric):
                          label_names=label_names, has_global_stats=True)
         self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _as_numpy(pred_label)
-            label = _as_numpy(label)
-            if pred_label.ndim > label.ndim:
-                pred_label = numpy.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.astype("int32").ravel()
-            label = label.astype("int32").ravel()
-            check_label_shapes(label, pred_label)
-            num_correct = (pred_label == label).sum()
-            self._update(float(num_correct), len(pred_label))
+    def _measure(self, label, pred):
+        if pred.ndim > label.ndim:
+            pred = numpy.argmax(pred, axis=self.axis)
+        pred = pred.astype("int64").ravel()
+        label = label.astype("int64").ravel()
+        check_label_shapes(label, pred)
+        return float((pred == label).sum()), label.size
 
 
 @register
 @_alias("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference: metric.py:560)."""
+    """Fraction of samples whose label lands in the k highest scores.
+
+    Ties are broken toward LOWER class indices (matching a stable
+    descending sort of the scores), so the result is deterministic.
+    """
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
-        super().__init__(name, top_k=top_k, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+        if top_k <= 1:
+            raise ValueError("TopKAccuracy needs top_k > 1 "
+                             "(k==1 is plain Accuracy)")
+        super().__init__("%s_%d" % (name, top_k), top_k=top_k,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(-_as_numpy(pred_label).astype("float32"),
-                                       axis=-1, kind="stable")
-            label = _as_numpy(label).astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                num_correct = (pred_label.ravel() == label.ravel()).sum()
-                self._update(float(num_correct), 0)
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    num_correct = (pred_label[:, j].ravel() == label.ravel()).sum()
-                    self._update(float(num_correct), 0)
-            self._update(0.0, num_samples)
+    def _measure(self, label, pred):
+        if pred.ndim == 1:
+            pred = pred[None, :]
+        if pred.ndim != 2:
+            raise ValueError("TopKAccuracy expects (N,) or (N, C) scores, "
+                             "got %s" % (pred.shape,))
+        label = label.astype("int64").ravel()
+        if label.shape[0] != pred.shape[0]:
+            raise ValueError("label/pred batch mismatch: %d vs %d"
+                             % (label.shape[0], pred.shape[0]))
+        k = min(self.top_k, pred.shape[1])
+        # stable argsort on the negated scores -> deterministic tie-breaks
+        ranked = numpy.argsort(-pred.astype("float64"), axis=1,
+                               kind="stable")[:, :k]
+        hits = (ranked == label[:, None]).any(axis=1)
+        return float(hits.sum()), label.shape[0]
 
 
-class _BinaryClassificationMetrics:
-    """Running TP/FP/TN/FN tallies (reference: metric.py:640)."""
+# ----------------------------------------------------------- confusion f1
+
+def _confusion_precision(m):
+    tp, fp = m[1, 1], m[0, 1]
+    return tp / (tp + fp) if tp + fp else 0.0
+
+
+def _confusion_recall(m):
+    tp, fn = m[1, 1], m[1, 0]
+    return tp / (tp + fn) if tp + fn else 0.0
+
+
+def _confusion_f1(m):
+    p, r = _confusion_precision(m), _confusion_recall(m)
+    return 2 * p * r / (p + r) if p + r else 0.0
+
+
+def _confusion_mcc(m):
+    tn, fp, fn, tp = m[0, 0], m[0, 1], m[1, 0], m[1, 1]
+    if not m.sum():
+        return 0.0
+    denom = 1.0
+    for t in ((tp + fp), (tp + fn), (tn + fp), (tn + fn)):
+        if t:
+            denom *= t
+    return (tp * tn - fp * fn) / math.sqrt(denom)
+
+
+class _Confusion:
+    """Binary confusion counts, rows=truth cols=decision, window + run."""
 
     def __init__(self):
-        self.reset_stats()
+        self.window = numpy.zeros((2, 2))
+        self.run = numpy.zeros((2, 2))
 
-    def update_binary_stats(self, label, pred):
-        pred = _as_numpy(pred)
-        label = _as_numpy(label).astype("int32")
-        pred_label = numpy.argmax(pred, axis=1)
-        check_label_shapes(label, pred)
-        if len(numpy.unique(label)) > 2:
-            raise ValueError("%s currently only supports binary classification."
-                             % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label == 1)
-        label_false = 1 - label_true
+    def observe(self, label, pred):
+        label = label.astype("int64").ravel()
+        decided = pred.argmax(axis=1).astype("int64").ravel() \
+            if pred.ndim == 2 else (pred.ravel() > 0.5).astype("int64")
+        if label.shape != decided.shape:
+            raise ValueError("label/pred shape mismatch: %s vs %s"
+                             % (label.shape, decided.shape))
+        if label.min(initial=0) < 0 or label.max(initial=0) > 1:
+            raise ValueError("binary metrics need labels in {0, 1}")
+        counts = numpy.zeros((2, 2))
+        numpy.add.at(counts, (label, decided), 1)
+        self.window += counts
+        self.run += counts
 
-        true_pos = (pred_true * label_true).sum()
-        false_pos = (pred_true * label_false).sum()
-        false_neg = (pred_false * label_true).sum()
-        true_neg = (pred_false * label_false).sum()
-        self.true_positives += true_pos
-        self.global_true_positives += true_pos
-        self.false_positives += false_pos
-        self.global_false_positives += false_pos
-        self.false_negatives += false_neg
-        self.global_false_negatives += false_neg
-        self.true_negatives += true_neg
-        self.global_true_negatives += true_neg
+    def clear_window(self):
+        self.window[:] = 0
 
-    @property
-    def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.0
+    def clear_all(self):
+        self.window[:] = 0
+        self.run[:] = 0
 
-    @property
-    def global_precision(self):
-        if self.global_true_positives + self.global_false_positives > 0:
-            return float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_positives)
-        return 0.0
 
-    @property
-    def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.0
+class _ConfusionMetric(EvalMetric):
+    """Shared frame for F1 and MCC: feed the confusion object, then either
+    average per-batch scores (macro) or score the cumulative matrix
+    (micro)."""
 
-    @property
-    def global_recall(self):
-        if self.global_true_positives + self.global_false_negatives > 0:
-            return float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_negatives)
-        return 0.0
+    _score = None  # staticmethod(matrix -> float), set by subclass
 
-    @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (self.precision + self.recall)
-        return 0.0
+    def __init__(self, name, output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self._conf = _Confusion()
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
 
-    @property
-    def global_fscore(self):
-        if self.global_precision + self.global_recall > 0:
-            return (2 * self.global_precision * self.global_recall
-                    / (self.global_precision + self.global_recall))
-        return 0.0
-
-    def matthewscc(self, use_global=False):
-        if use_global:
-            if not self.global_total_examples:
-                return 0.0
-            true_pos = float(self.global_true_positives)
-            false_pos = float(self.global_false_positives)
-            false_neg = float(self.global_false_negatives)
-            true_neg = float(self.global_true_negatives)
+    def update(self, labels, preds):
+        for label, pred in _paired(labels, preds):
+            self._conf.observe(label, pred)
+        score = type(self)._score
+        if self.average == "macro":
+            # one data point per update() call; run scope scores the
+            # cumulative matrix (reference semantics)
+            self._tally.wsum += score(self._conf.window)
+            self._tally.n += 1
+            self._tally.run_wsum += score(self._conf.run)
+            self._tally.run_n += 1
+            self._conf.clear_window()
         else:
-            if not self.total_examples:
-                return 0.0
-            true_pos = float(self.true_positives)
-            false_pos = float(self.false_positives)
-            false_neg = float(self.false_negatives)
-            true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos),
-                 (true_pos + false_neg),
-                 (true_neg + false_pos),
-                 (true_neg + false_neg)]
-        denom = 1.0
-        for t in filter(lambda t: t != 0.0, terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+            self._tally.n = self._conf.window.sum()
+            self._tally.run_n = self._conf.run.sum()
 
-    @property
-    def total_examples(self):
-        return (self.false_negatives + self.false_positives
-                + self.true_negatives + self.true_positives)
+    def get(self):
+        if self.average == "macro":
+            return (self.name, self._tally.mean())
+        if not self._conf.window.sum():
+            return (self.name, float("nan"))
+        return (self.name, type(self)._score(self._conf.window))
 
-    @property
-    def global_total_examples(self):
-        return (self.global_false_negatives + self.global_false_positives
-                + self.global_true_negatives + self.global_true_positives)
+    def get_global(self):
+        if self.average == "macro":
+            return (self.name, self._tally.run_mean())
+        if not self._conf.run.sum():
+            return (self.name, float("nan"))
+        return (self.name, type(self)._score(self._conf.run))
 
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
-        self.global_false_positives = 0
-        self.global_false_negatives = 0
-        self.global_true_positives = 0
-        self.global_true_negatives = 0
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_conf"):
+            self._conf.clear_all()
 
-    def local_reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+    def reset_local(self):
+        super().reset_local()
+        self._conf.clear_window()
 
 
 @register
-class F1(EvalMetric):
-    """Binary F1 (reference: metric.py:761)."""
+class F1(_ConfusionMetric):
+    """Binary F1 (harmonic mean of precision and recall)."""
+
+    _score = staticmethod(_confusion_f1)
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.global_sum_metric += self.metrics.global_fscore
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self.metrics.local_reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.global_sum_metric = (self.metrics.global_fscore
-                                      * self.metrics.global_total_examples)
-            self.num_inst = self.metrics.total_examples
-            self.global_num_inst = self.metrics.global_total_examples
-
-    def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
-        self.global_num_inst = 0.0
-        self.global_sum_metric = 0.0
-        self.metrics.reset_stats()
-
-    def reset_local(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
-        self.metrics.local_reset_stats()
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, average=average)
 
 
 @register
-class MCC(EvalMetric):
-    """Matthews correlation coefficient (reference: metric.py:838)."""
+class MCC(_ConfusionMetric):
+    """Matthews correlation coefficient over the binary confusion matrix."""
+
+    _score = staticmethod(_confusion_mcc)
 
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, average=average)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc()
-            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self._metrics.local_reset_stats()
-        else:
-            self.sum_metric = (self._metrics.matthewscc()
-                               * self._metrics.total_examples)
-            self.global_sum_metric = (self._metrics.matthewscc(use_global=True)
-                                      * self._metrics.global_total_examples)
-            self.num_inst = self._metrics.total_examples
-            self.global_num_inst = self._metrics.global_total_examples
 
-    def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
-        self.global_sum_metric = 0.0
-        self.global_num_inst = 0.0
-        self._metrics.reset_stats()
+# --------------------------------------------------------------- likelihood
 
-    def reset_local(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
-        self._metrics.local_reset_stats()
+def _picked_probs(label, pred):
+    """Probability each sample's model assigned to its true class."""
+    label = label.astype("int64").ravel()
+    flat = pred.reshape(-1, pred.shape[-1])
+    if label.shape[0] != flat.shape[0]:
+        raise ValueError("label count %d != prediction rows %d"
+                         % (label.shape[0], flat.shape[0]))
+    return flat[numpy.arange(label.shape[0]), label], label
 
 
 @register
 class Perplexity(EvalMetric):
-    """Perplexity (reference: metric.py:941)."""
+    """exp(mean negative log likelihood), optionally skipping a pad label."""
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
@@ -523,107 +514,30 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[
-                numpy.arange(label.size), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= int(numpy.sum(ignore))
-                probs = probs * (1 - ignore) + ignore
-            loss -= float(numpy.sum(numpy.log(numpy.maximum(1e-10, probs))))
-            num += label.size
-        self._update(loss, num)
+    def _measure(self, label, pred):
+        probs, label = _picked_probs(label, pred)
+        if self.ignore_label is not None:
+            keep = label != self.ignore_label
+            probs = numpy.where(keep, probs, 1.0)
+            count = int(keep.sum())
+        else:
+            count = label.size
+        nll = -float(numpy.log(numpy.maximum(probs, 1e-10)).sum())
+        return nll, count
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+        m = self._tally.mean()
+        return (self.name, math.exp(m) if m == m else float("nan"))
 
     def get_global(self):
-        if self.global_num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
-
-
-@register
-class MAE(EvalMetric):
-    """Mean absolute error (reference: metric.py:1025)."""
-
-    def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            num = len(pred)
-            mae = numpy.abs(label - pred).mean()
-            self._update(mae * num, num)
-
-
-@register
-class MSE(EvalMetric):
-    """Mean squared error (reference: metric.py:1079)."""
-
-    def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            num = len(pred)
-            mse = ((label - pred) ** 2.0).mean()
-            self._update(mse * num, num)
-
-
-@register
-class RMSE(EvalMetric):
-    """Root mean squared error (reference: metric.py:1133)."""
-
-    def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            num = len(pred)
-            rmse = numpy.sqrt(((label - pred) ** 2.0).mean())
-            self._update(rmse * num, num)
+        m = self._tally.run_mean()
+        return (self.name, math.exp(m) if m == m else float("nan"))
 
 
 @register
 @_alias("ce")
 class CrossEntropy(EvalMetric):
-    """Cross-entropy of predicted probabilities (reference: metric.py:1188)."""
+    """Mean -log p(true class) over predicted probability rows."""
 
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
@@ -631,125 +545,130 @@ class CrossEntropy(EvalMetric):
                          label_names=label_names, has_global_stats=True)
         self.eps = eps
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            cross_entropy = (-numpy.log(prob + self.eps)).sum()
-            self._update(cross_entropy, label.shape[0])
+    def _measure(self, label, pred):
+        probs, label = _picked_probs(label, pred)
+        return float(-numpy.log(probs + self.eps).sum()), label.size
 
 
 @register
 @_alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
-    """NLL (reference: metric.py:1254)."""
+class NegativeLogLikelihood(CrossEntropy):
+    """Alias semantics of CrossEntropy under the reference's nll name."""
 
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        self.eps = eps
+        super().__init__(eps=eps, name=name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples), numpy.int64(label)]
-            nll = (-numpy.log(prob + self.eps)).sum()
-            self._update(nll, num_examples)
+
+# --------------------------------------------------------------- regression
+
+class _RegressionMetric(EvalMetric):
+    """Per-batch error statistic of (label - pred)."""
+
+    def __init__(self, name, output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    @staticmethod
+    def _error(diff):
+        raise NotImplementedError
+
+    def _measure(self, label, pred):
+        label = label.reshape(label.shape[0], -1)
+        pred = pred.reshape(pred.shape[0], -1)
+        n = pred.shape[0]
+        return self._error(label - pred) * n, n
+
+
+@register
+class MAE(_RegressionMetric):
+    """Mean absolute error."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return float(numpy.abs(diff).mean())
+
+
+@register
+class MSE(_RegressionMetric):
+    """Mean squared error."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return float((diff ** 2).mean())
+
+
+@register
+class RMSE(_RegressionMetric):
+    """Root mean squared error (per batch, then averaged)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return float(numpy.sqrt((diff ** 2).mean()))
 
 
 @register
 @_alias("pearsonr")
 class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference: metric.py:1320)."""
+    """Pearson r; macro = mean per-batch r, micro = streaming moments."""
 
     def __init__(self, name="pearsonr", output_names=None, label_names=None,
                  average="macro"):
         self.average = average
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        if self.average == "micro":
-            self.reset_micro()
-
-    def reset_micro(self):
-        self._sse_p = 0
-        self._mean_p = 0
-        self._sse_l = 0
-        self._mean_l = 0
-        self._pred_nums = 0
-        self._label_nums = 0
-        self._conv = 0
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
-        self.global_num_inst = 0
-        self.global_sum_metric = 0.0
-        if getattr(self, "average", None) == "micro":
-            self.reset_micro()
-
-    def update_variance(self, new_values, *aggregate):
-        count = len(new_values)
-        mean = numpy.mean(new_values)
-        variance = numpy.sum((new_values - mean) ** 2)
-        count_a, mean_a, var_a = aggregate
-        delta = mean - mean_a
-        m_a = var_a * (count_a - 1)
-        m_b = variance * (count - 1)
-        M2 = m_a + m_b + delta ** 2 * count_a * count / (count_a + count)
-        count_a += count
-        mean_a = (count_a * mean_a + count * mean) / count_a
-        var_a = M2 / (count_a - 1)
-        return count_a, mean_a, var_a
-
-    def update_cov(self, label, pred):
-        self._conv = self._conv + numpy.sum(
-            (label - self._mean_l) * (pred - self._mean_p))
+        super().reset()
+        # shifted-moment accumulators for the micro (streaming) estimate;
+        # moments are taken about a pivot (the first seen value) so the
+        # n*Σxx - (Σx)² cancellation never sees large absolute magnitudes
+        self._m = numpy.zeros(6)  # n, Σl, Σp, Σll, Σpp, Σlp  (pivot-shifted)
+        self._pivot = None
 
     def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
+        for label, pred in _paired(labels, preds):
             check_label_shapes(label, pred, False, True)
-            label = _as_numpy(label).ravel().astype(numpy.float64)
-            pred = _as_numpy(pred).ravel().astype(numpy.float64)
+            label = label.ravel().astype(numpy.float64)
+            pred = pred.ravel().astype(numpy.float64)
             if self.average == "macro":
-                pearson_corr = numpy.corrcoef(pred, label)[0, 1]
-                self._update(pearson_corr, 1)
+                self._tally.add(float(numpy.corrcoef(pred, label)[0, 1]), 1)
             else:
-                self.global_num_inst += 1
-                self.num_inst += 1
-                self._label_nums, self._mean_l, self._sse_l = \
-                    self.update_variance(label, self._label_nums,
-                                         self._mean_l, self._sse_l)
-                self.update_cov(label, pred)
-                self._pred_nums, self._mean_p, self._sse_p = \
-                    self.update_variance(pred, self._pred_nums,
-                                         self._mean_p, self._sse_p)
+                if self._pivot is None:
+                    self._pivot = (float(label[0]), float(pred[0])) \
+                        if label.size else (0.0, 0.0)
+                label = label - self._pivot[0]
+                pred = pred - self._pivot[1]
+                self._m += [label.size, label.sum(), pred.sum(),
+                            (label * label).sum(), (pred * pred).sum(),
+                            (label * pred).sum()]
+                self._tally.add(0.0, 1)
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
         if self.average == "macro":
-            return (self.name, self.sum_metric / self.num_inst)
-        n = self._label_nums
-        numerator = self._conv
-        denominator = (n - 1) * numpy.sqrt(self._sse_p) * numpy.sqrt(self._sse_l)
-        pearsonr = numerator / denominator
-        return (self.name, pearsonr)
+            return (self.name, self._tally.mean())
+        n, sl, sp, sll, spp, slp = self._m
+        cov = n * slp - sl * sp
+        spread = math.sqrt(max(n * sll - sl * sl, 0.0)) * \
+            math.sqrt(max(n * spp - sp * sp, 0.0))
+        return (self.name, cov / spread if spread else float("nan"))
 
 
 @register
 class Loss(EvalMetric):
-    """Dummy metric averaging a loss output (reference: metric.py:1439)."""
+    """Average of an already-computed loss output."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
@@ -759,13 +678,12 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            loss = float(numpy.sum(_as_numpy(pred)))
-            self._update(loss, pred.size)
+            self._tally.add(float(_host(pred).sum()), pred.size)
 
 
 @register
 class Torch(Loss):
-    """Compat alias (reference: metric.py:1466)."""
+    """Compat alias kept for reference script parity."""
 
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -773,7 +691,7 @@ class Torch(Loss):
 
 @register
 class Caffe(Loss):
-    """Compat alias (reference: metric.py:1474)."""
+    """Compat alias kept for reference script parity."""
 
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -781,13 +699,13 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
-    """Metric from a feval function (reference: metric.py:1482)."""
+    """Wraps feval(label, pred) -> value or (sum, count)."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
@@ -798,23 +716,21 @@ class CustomMetric(EvalMetric):
 
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
-            labels, preds = check_label_shapes(labels, preds, True)
-        for pred, label in zip(preds, labels):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self._update(sum_metric, num_inst)
+            check_label_shapes(labels if isinstance(labels, list) else [labels],
+                               preds if isinstance(preds, list) else [preds])
+        for label, pred in _paired(labels, preds):
+            out = self._feval(label, pred)
+            if isinstance(out, tuple):
+                self._tally.add(*out)
             else:
-                self._update(reval, 1)
+                self._tally.add(out, 1)
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy feval as a metric (reference: metric.py:1551)."""
+    """Lift a bare numpy feval into a CustomMetric."""
 
     def feval(label, pred):
         return numpy_feval(label, pred)

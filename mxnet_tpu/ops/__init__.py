@@ -5,5 +5,10 @@ from . import nn  # noqa: F401  (registers nn ops)
 from . import rnn  # noqa: F401  (registers recurrent ops)
 from . import control_flow  # noqa: F401  (registers foreach/while_loop/cond)
 from . import contrib  # noqa: F401  (registers bbox/NMS/ROI detection ops)
+from . import linalg  # noqa: F401  (registers _linalg_* ops)
+from . import random_ops  # noqa: F401  (registers _random_*/sample_* ops)
+from . import spatial  # noqa: F401  (registers sampler/warp/deformable ops)
+from . import signal  # noqa: F401  (registers fft/ifft)
+from . import optim_ops  # noqa: F401  (registers *_update optimizer ops)
 
 __all__ = ["Operator", "apply_op", "get", "invoke", "list_ops", "register"]

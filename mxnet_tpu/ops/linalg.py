@@ -1,0 +1,159 @@
+"""Advanced linear-algebra ops.
+
+Reference: src/operator/tensor/la_op.cc (NNVM ops _linalg_gemm, _linalg_potri,
+_linalg_trmm, _linalg_sumlogdiag, _linalg_extractdiag/_makediag,
+_linalg_extracttrian/_maketrian, _linalg_gelqf, _linalg_syevd,
+_linalg_inverse, _linalg_det, _linalg_slogdet) and contrib/krprod.cc
+(khatri_rao).  TPU-native: each op is a jnp.linalg / lax.linalg lowering;
+XLA's batched LAPACK-style kernels replace the reference's per-batch BLAS
+loops, and gradients come from jax's built-in linalg JVP/VJP rules instead of
+hand-written _backward_* ops.
+
+gemm2/potrf/trsm/syrk live in tensor.py (registered in round 1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("linalg_gemm", aliases=("_linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2, **_):
+    """C' = alpha*op(A)op(B) + beta*C (reference la_op.cc:40)."""
+    a = jnp.asarray(A)
+    b = jnp.asarray(B)
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * jnp.asarray(C)
+
+
+@register("linalg_potri", aliases=("_linalg_potri",))
+def _linalg_potri(A, **_):
+    """Inverse of SPD matrix FROM its Cholesky factor L: (L L^T)^-1
+    (reference la_op.cc:240)."""
+    L = jnp.asarray(A)
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+@register("linalg_trmm", aliases=("_linalg_trmm",))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0, **_):
+    """Triangular matrix multiply alpha*op(A)*B (reference la_op.cc:298)."""
+    a = jnp.asarray(A)
+    if not lower:
+        a = jnp.triu(a)
+    else:
+        a = jnp.tril(a)
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+    b = jnp.asarray(B)
+    out = jnp.matmul(b, a) if rightside else jnp.matmul(a, b)
+    return alpha * out
+
+
+@register("linalg_sumlogdiag", aliases=("_linalg_sumlogdiag",))
+def _linalg_sumlogdiag(A, **_):
+    """sum(log(diag(A))) per batch matrix (reference la_op.cc:423)."""
+    a = jnp.asarray(A)
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag", aliases=("_linalg_extractdiag",))
+def _linalg_extractdiag(A, offset=0, **_):
+    return jnp.diagonal(jnp.asarray(A), offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", aliases=("_linalg_makediag",))
+def _linalg_makediag(A, offset=0, **_):
+    a = jnp.asarray(A)
+    n = a.shape[-1] + abs(offset)
+    out_shape = a.shape[:-1] + (n, n)
+    idx = jnp.arange(a.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(out_shape, a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+def _trian_indices(n, offset, lower):
+    if lower:
+        r, c = jnp.tril_indices(n, k=offset)
+    else:
+        r, c = jnp.triu_indices(n, k=offset)
+    return r, c
+
+
+@register("linalg_extracttrian", aliases=("_linalg_extracttrian",))
+def _linalg_extracttrian(A, offset=0, lower=True, **_):
+    """Pack a triangle of each matrix into a vector (reference la_op.cc:569)."""
+    a = jnp.asarray(A)
+    r, c = _trian_indices(a.shape[-1], offset, lower)
+    return a[..., r, c]
+
+
+@register("linalg_maketrian", aliases=("_linalg_maketrian",))
+def _linalg_maketrian(A, offset=0, lower=True, **_):
+    """Unpack a vector back into a triangular matrix (reference la_op.cc:627)."""
+    a = jnp.asarray(A)
+    m = a.shape[-1]
+    # m = n*(n+1)/2 - adjustment for offset; solve for n
+    k = abs(offset)
+    # number of packed elements for size n with offset: full triangle of
+    # (n - k) plus nothing else; invert n from m
+    nk = int((-1 + (1 + 8 * m) ** 0.5) / 2)
+    n = nk + k
+    r, c = _trian_indices(n, offset, lower)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., r, c].set(a)
+
+
+@register("linalg_gelqf", aliases=("_linalg_gelqf",), num_outputs=2)
+def _linalg_gelqf(A, **_):
+    """LQ factorization A = L·Q with Q's rows orthonormal
+    (reference la_op.cc:752).  Lowered via QR of Aᵀ."""
+    a = jnp.asarray(A)
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", aliases=("_linalg_syevd",), num_outputs=2)
+def _linalg_syevd(A, **_):
+    """Symmetric eigendecomposition, returns (U, lambda) with rows of U the
+    eigenvectors: A = Uᵀ diag(lambda) U (reference la_op.cc:823)."""
+    w, v = jnp.linalg.eigh(jnp.asarray(A))
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_inverse", aliases=("_linalg_inverse", "inverse"))
+def _linalg_inverse(A, **_):
+    return jnp.linalg.inv(jnp.asarray(A))
+
+
+@register("linalg_det", aliases=("_linalg_det", "det"))
+def _linalg_det(A, **_):
+    return jnp.linalg.det(jnp.asarray(A))
+
+
+@register("linalg_slogdet", aliases=("_linalg_slogdet", "slogdet"),
+          num_outputs=2)
+def _linalg_slogdet(A, **_):
+    sign, logabs = jnp.linalg.slogdet(jnp.asarray(A))
+    return sign, logabs
+
+
+@register("khatri_rao")
+def _khatri_rao(*matrices, **_):
+    """Column-wise Kronecker product (reference contrib/krprod.cc)."""
+    mats = [jnp.asarray(m) for m in matrices]
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out

@@ -151,18 +151,25 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.asarray(gamma)
     if fix_gamma:
         g = jnp.ones_like(g)
+    # Statistics and the normalization arithmetic run in f32 even when the
+    # activations are bf16 (mixed-precision policy): the reduction over
+    # N*H*W elements loses too much in bf16, and XLA fuses the widened
+    # elementwise chain into the surrounding ops at no extra HBM cost.
+    xf = x.astype(jnp.float32)
     red_axes = tuple(i for i in range(x.ndim) if i != axis)
     if training and not use_global_stats:
-        mean = jnp.mean(x, axis=red_axes)
-        var = jnp.var(x, axis=red_axes)
+        mean = jnp.mean(xf, axis=red_axes)
+        var = jnp.var(xf, axis=red_axes)
     else:
-        mean = jnp.asarray(moving_mean)
-        var = jnp.asarray(moving_var)
+        mean = jnp.asarray(moving_mean).astype(jnp.float32)
+        var = jnp.asarray(moving_var).astype(jnp.float32)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     inv = lax.rsqrt(var + eps).reshape(shape)
-    out = (x - mean.reshape(shape)) * inv * g.reshape(shape) + jnp.asarray(beta).reshape(shape)
-    return out, mean, var
+    out = ((xf - mean.reshape(shape)) * inv
+           * g.astype(jnp.float32).reshape(shape)
+           + jnp.asarray(beta).astype(jnp.float32).reshape(shape))
+    return out.astype(x.dtype), mean, var
 
 
 @register("LayerNorm", aliases=("layer_norm",))
@@ -372,3 +379,126 @@ def _upsampling(data, scale=2, sample_type="nearest", **_):
     x = jnp.asarray(data)
     out = jnp.repeat(jnp.repeat(x, scale, axis=-2), scale, axis=-1)
     return out
+
+
+# ------------------------------------------------- round-3 coverage widening
+
+@register("LRN", aliases=("lrn",), num_outputs=2)
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **_):
+    """Local response normalization across channels (reference
+    src/operator/nn/lrn.cc).  Returns (out, norm_scale) like the reference's
+    two-output registration."""
+    x = jnp.asarray(data)
+    half = nsize // 2
+    sq = jnp.square(x)
+    # windowed channel sum via padded cumulative trick
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(pad[:, i:i + x.shape[1]] for i in range(nsize))
+    scale = knorm + (alpha / nsize) * windows
+    return x / jnp.power(scale, beta), scale
+
+
+@register("SoftmaxActivation", aliases=("softmax_activation",))
+def _softmax_activation(data, mode="instance", **_):
+    """Deprecated-in-reference but still registered op
+    (src/operator/nn/softmax_activation.cc)."""
+    x = jnp.asarray(data)
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(data, alpha=0.2, beta=0.5, **_):
+    x = jnp.asarray(data)
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label, **_):
+    """Total CE of logits vs int labels, scalar output (reference
+    src/operator/loss_binary_op.cc)."""
+    x = jnp.asarray(data)
+    lab = jnp.asarray(label).astype(jnp.int32).ravel()
+    logp = jax.nn.log_softmax(x, axis=-1)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+def _regression_output(name, fwd, grad):
+    """Shared frame for the *RegressionOutput heads (reference
+    src/operator/regression_output.cc): forward transforms data, backward
+    IGNORES the incoming cotangent and emits its own per-example gradient —
+    these ops define their loss implicitly."""
+
+    @jax.custom_vjp
+    def core(data, label):
+        return fwd(data)
+
+    def core_fwd(data, label):
+        out = fwd(data)
+        return out, (out, label, data.shape[0])
+
+    def core_bwd(res, g):
+        out, label, batch = res
+        return (grad(out, label) / batch, jnp.zeros_like(label))
+
+    core.defvjp(core_fwd, core_bwd)
+
+    @register(name, aliases=(_snake(name),))
+    def op(data, label, grad_scale=1.0, **_):
+        return core(jnp.asarray(data),
+                    jnp.asarray(label).astype(jnp.asarray(data).dtype))
+    return op
+
+
+def _snake(name):
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i and not name[i - 1].isupper():
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+_regression_output("LinearRegressionOutput",
+                   lambda x: x,
+                   lambda out, label: out - label.reshape(out.shape))
+_regression_output("LogisticRegressionOutput",
+                   jax.nn.sigmoid,
+                   lambda out, label: out - label.reshape(out.shape))
+_regression_output("MAERegressionOutput",
+                   lambda x: x,
+                   lambda out, label: jnp.sign(out - label.reshape(out.shape)))
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **_):
+    """Forward is identity; the hinge-loss gradient is defined by the op
+    (reference src/operator/svm_output.cc)."""
+    x = jnp.asarray(data)
+    lab = jnp.asarray(label).astype(jnp.int32)
+
+    @jax.custom_vjp
+    def core(d, l):
+        return d
+
+    def core_fwd(d, l):
+        return d, (d, l)
+
+    def core_bwd(res, g):
+        d, l = res
+        onehot = jax.nn.one_hot(l, d.shape[-1], dtype=d.dtype)
+        signed = jnp.where(onehot > 0, d, -d)
+        viol = (margin - signed) > 0
+        if use_linear:
+            gd = jnp.where(viol, jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+        else:
+            gd = jnp.where(viol, 2.0 * (margin - signed)
+                           * jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+        return (regularization_coefficient * gd.astype(d.dtype),
+                jnp.zeros_like(l))
+
+    core.defvjp(core_fwd, core_bwd)
+    return core(x, lab)

@@ -1,0 +1,335 @@
+"""Optimizer update ops (registry-level).
+
+Reference: src/operator/optimizer_op.cc (sgd/adam/rmsprop/ftrl/ftml/nag/
+signum families, multi-tensor variants :320-656) and contrib/adamw.cc,
+contrib/multi_sum_sq.cc, contrib/multi_lars.cc, contrib/lamb (la
+mb_update_phase1/2).
+
+TPU-native re-design: the reference ops MUTATE weight/state tensors in
+place; here every op is pure and RETURNS the updated tensors (weight first,
+then states) — in-place semantics don't exist on immutable jax.Arrays, and
+the functional form is what a jitted train step needs anyway.  The gluon
+Trainer path uses optimizer/optimizer.py's step() functions; these registry
+ops provide script-level parity (mx.nd.sgd_update etc.) and feed the op
+sweep.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = jnp.asarray(grad) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update")
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=False, **_):
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return w - lr * (g + wd * w)
+
+
+@register("sgd_mom_update", num_outputs=2)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False,
+                    **_):
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = momentum * jnp.asarray(mom) - lr * (g + wd * w)
+    return w + m, m
+
+
+@register("mp_sgd_update", num_outputs=2)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **_):
+    """Multi-precision sgd: master f32 copy updated, low-precision weight
+    recast from it (reference optimizer_op.cc:589)."""
+    w32 = jnp.asarray(weight32)
+    g = _prep(grad, rescale_grad, clip_gradient).astype(jnp.float32)
+    new32 = w32 - lr * (g + wd * w32)
+    return new32.astype(jnp.asarray(weight).dtype), new32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    w32 = jnp.asarray(weight32)
+    g = _prep(grad, rescale_grad, clip_gradient).astype(jnp.float32)
+    m = momentum * jnp.asarray(mom) - lr * (g + wd * w32)
+    new32 = w32 + m
+    return new32.astype(jnp.asarray(weight).dtype), m, new32
+
+
+@register("nag_mom_update", num_outputs=2)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Nesterov momentum (reference optimizer_op.cc:710)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * w
+    m = momentum * jnp.asarray(mom) + g
+    return w - lr * (g + momentum * m), m
+
+
+@register("mp_nag_mom_update", num_outputs=3)
+def _mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    w32 = jnp.asarray(weight32)
+    g = _prep(grad, rescale_grad, clip_gradient).astype(jnp.float32) \
+        + wd * w32
+    m = momentum * jnp.asarray(mom) + g
+    new32 = w32 - lr * (g + momentum * m)
+    return new32.astype(jnp.asarray(weight).dtype), m, new32
+
+
+@register("adam_update", num_outputs=3)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=False, **_):
+    """Adam step WITHOUT bias correction — the reference kernel expects the
+    caller to fold the correction into lr (optimizer_op.cc:656)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * w
+    m = beta1 * jnp.asarray(mean) + (1 - beta1) * g
+    v = beta2 * jnp.asarray(var) + (1 - beta2) * g * g
+    return w - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+@register("ftml_update", num_outputs=4)
+def _ftml_update(weight, grad, d, v, z, lr=0.001, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0, **_):
+    """FTML (reference optimizer_op.cc:624)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_grad) + wd * w
+    v_new = beta2 * jnp.asarray(v) + (1 - beta2) * g * g
+    d_new = (1 - beta1 ** t) / lr * \
+        (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * jnp.asarray(d)
+    z_new = beta1 * jnp.asarray(z) + (1 - beta1) * g - sigma * w
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+@register("rmsprop_update", num_outputs=2)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **_):
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * w
+    n_new = gamma1 * jnp.asarray(n) + (1 - gamma1) * g * g
+    new_w = w - lr * g / (jnp.sqrt(n_new) + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, n_new
+
+
+@register("rmspropalex_update", num_outputs=4)
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **_):
+    """RMSProp with Graves' centered variant (reference
+    optimizer_op.cc:811)."""
+    w = jnp.asarray(weight)
+    gr = _prep(grad, rescale_grad, clip_gradient) + wd * w
+    n_new = gamma1 * jnp.asarray(n) + (1 - gamma1) * gr * gr
+    g_new = gamma1 * jnp.asarray(g) + (1 - gamma1) * gr
+    delta_new = gamma2 * jnp.asarray(delta) - \
+        lr * gr / jnp.sqrt(n_new - g_new * g_new + epsilon)
+    new_w = w + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, n_new, g_new, delta_new
+
+
+@register("ftrl_update", num_outputs=3)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """FTRL-proximal (reference optimizer_op.cc:852)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    n_old = jnp.asarray(n)
+    n_new = n_old + g * g
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_old)) / lr
+    z_new = jnp.asarray(z) + g - sigma * w
+    new_w = jnp.where(
+        jnp.abs(z_new) <= lamda1,
+        jnp.zeros_like(w),
+        -(z_new - jnp.sign(z_new) * lamda1)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return new_w, z_new, n_new
+
+
+@register("signsgd_update")
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **_):
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return w - lr * jnp.sign(g + wd * w)
+
+
+@register("signum_update", num_outputs=2)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **_):
+    """Signum (reference optimizer_op.cc:73)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = momentum * jnp.asarray(mom) - (1 - momentum) * (g + wd * w)
+    new_w = (1 - lr * wd_lh) * w + lr * jnp.sign(m)
+    return new_w, m
+
+
+# ------------------------------------------------------------- multi-tensor
+
+@register("multi_sum_sq", differentiable=False,
+          aliases=("_contrib_multi_sum_sq",))
+def _multi_sum_sq(*arrays, num_arrays=None, **_):
+    """Per-array sum of squares in one call (reference
+    contrib/multi_sum_sq.cc — the LARS norm pre-pass)."""
+    n = num_arrays if num_arrays is not None else len(arrays)
+    return jnp.stack([jnp.sum(jnp.square(jnp.asarray(a)))
+                      for a in arrays[:n]])
+
+
+@register("multi_lars", differentiable=False,
+          aliases=("_contrib_multi_lars",))
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0, **_):
+    """Layer-wise adaptive LR scaling (reference contrib/multi_lars.cc)."""
+    lr = jnp.asarray(lrs)
+    wn = jnp.sqrt(jnp.asarray(weights_sum_sq))
+    gn = jnp.sqrt(jnp.asarray(grads_sum_sq)) * rescale_grad
+    wd = jnp.asarray(wds)
+    trust = eta * wn / (gn + wd * wn + eps)
+    return jnp.where((wn > 0) & (gn > 0), lr * trust, lr)
+
+
+def _multi_pairs(tensors, per):
+    n = len(tensors) // per
+    return [tensors[i * per:(i + 1) * per] for i in range(n)]
+
+
+@register("multi_sgd_update", num_outputs=-1)
+def _multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=None, **_):
+    """Fused sgd over N (weight, grad) pairs (reference
+    optimizer_op.cc:320); returns the N updated weights."""
+    outs = []
+    for i, (w, g) in enumerate(_multi_pairs(args, 2)):
+        outs.append(_sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", num_outputs=-1)
+def _multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=None, **_):
+    """Fused momentum sgd over N (weight, grad, mom) triples; returns N
+    updated weights followed by N updated momenta."""
+    ws, ms = [], []
+    for i, (w, g, m) in enumerate(_multi_pairs(args, 3)):
+        nw, nm = _sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                 wd=wds[i], rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+    return tuple(ws) + tuple(ms)
+
+
+@register("multi_mp_sgd_update", num_outputs=-1)
+def _multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None, **_):
+    ws, w32s = [], []
+    for i, (w, g, w32) in enumerate(_multi_pairs(args, 3)):
+        nw, n32 = _mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        ws.append(nw)
+        w32s.append(n32)
+    return tuple(ws) + tuple(w32s)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=-1)
+def _multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=None, **_):
+    ws, ms, w32s = [], [], []
+    for i, (w, g, m, w32) in enumerate(_multi_pairs(args, 4)):
+        nw, nm, n32 = _mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+        w32s.append(n32)
+    return tuple(ws) + tuple(ms) + tuple(w32s)
+
+
+# ------------------------------------------------------------ adamw / lamb
+
+@register("_adamw_update", aliases=("adamw_update",), num_outputs=3)
+def _adamw_update(weight, grad, mean, var, rescale_grad, lr=0.001, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                  clip_gradient=-1.0, **_):
+    """AdamW with decoupled weight decay (reference contrib/adamw.cc:79).
+    rescale_grad is a TENSOR input (dynamic loss scale)."""
+    w = jnp.asarray(weight)
+    g = jnp.asarray(grad) * jnp.asarray(rescale_grad)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * jnp.asarray(mean) + (1 - beta1) * g
+    v = beta2 * jnp.asarray(var) + (1 - beta2) * g * g
+    new_w = w - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * w)
+    return new_w, m, v
+
+
+@register("_mp_adamw_update", aliases=("mp_adamw_update",), num_outputs=4)
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                     lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                     eta=1.0, clip_gradient=-1.0, **_):
+    w32 = jnp.asarray(weight32)
+    g = (jnp.asarray(grad) * jnp.asarray(rescale_grad)).astype(jnp.float32)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * jnp.asarray(mean) + (1 - beta1) * g
+    v = beta2 * jnp.asarray(var) + (1 - beta2) * g * g
+    new32 = w32 - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * w32)
+    return new32.astype(jnp.asarray(weight).dtype), m, v, new32
+
+
+@register("lamb_update_phase1", num_outputs=3)
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """LAMB phase 1: the raw update direction (reference contrib lamb op)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * jnp.asarray(mean) + (1 - beta1) * g
+    v = beta2 * jnp.asarray(var) + (1 - beta2) * g * g
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * w, m, v
+
+
+@register("lamb_update_phase2")
+def _lamb_update_phase2(weight, g, r1, r2, lr=0.001, lower_bound=-1.0,
+                        upper_bound=-1.0, **_):
+    """LAMB phase 2: trust-ratio scaled apply."""
+    w = jnp.asarray(weight)
+    r1v = jnp.asarray(r1)
+    r2v = jnp.asarray(r2)
+    if lower_bound is not None and lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v,
+                      jnp.ones_like(r1v))
+    return w - lr * ratio * jnp.asarray(g)

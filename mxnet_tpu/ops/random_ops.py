@@ -1,0 +1,239 @@
+"""Registry-level random sampling ops.
+
+Reference: src/operator/random/sample_op.cc (_random_uniform/_normal/_gamma/
+_exponential/_poisson/_negative_binomial/_generalized_negative_binomial/
+_randint + *_like variants), multisample_op.cc (sample_* taking per-row
+distribution parameter tensors) and sample_multinomial_op.cc.
+
+TPU-native: every sampler draws from the framework PRNG stream
+(mxnet_tpu/random.py — jax.random splittable keys behind mx.random.seed,
+replacing the reference's per-device mt19937/Philox state,
+include/mxnet/random_generator.h).  Samplers are non-differentiable
+registry ops, matching the reference's FGradient-less registration.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _key():
+    from ..random import next_key
+    return next_key()
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _f(dtype):
+    return jnp.float32 if dtype in (None, "None") else jnp.dtype(dtype)
+
+
+# ------------------------------------------------- fixed-parameter samplers
+
+@register("_random_uniform", differentiable=False,
+          aliases=("random_uniform", "uniform"))
+def _random_uniform(low=0.0, high=1.0, shape=None, dtype=None, **_):
+    return jax.random.uniform(_key(), _shape(shape), _f(dtype), low, high)
+
+
+@register("_random_normal", differentiable=False,
+          aliases=("random_normal", "normal"))
+def _random_normal(loc=0.0, scale=1.0, shape=None, dtype=None, **_):
+    return loc + scale * jax.random.normal(_key(), _shape(shape), _f(dtype))
+
+
+@register("_random_gamma", differentiable=False, aliases=("random_gamma",))
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, **_):
+    return beta * jax.random.gamma(_key(), alpha, _shape(shape), _f(dtype))
+
+
+@register("_random_exponential", differentiable=False,
+          aliases=("random_exponential",))
+def _random_exponential(lam=1.0, shape=None, dtype=None, **_):
+    return jax.random.exponential(_key(), _shape(shape), _f(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False,
+          aliases=("random_poisson",))
+def _random_poisson(lam=1.0, shape=None, dtype=None, **_):
+    out = jax.random.poisson(_key(), lam, _shape(shape))
+    return out.astype(_f(dtype))
+
+
+def _neg_binomial(key, k, p, shape, dtype):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) — the standard mixture
+    construction (the reference samples it the same way on GPU)."""
+    k1, k2 = jax.random.split(key)
+    rate = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, rate, shape).astype(dtype)
+
+
+@register("_random_negative_binomial", differentiable=False,
+          aliases=("random_negative_binomial",))
+def _random_negative_binomial(k=1, p=1.0, shape=None, dtype=None, **_):
+    return _neg_binomial(_key(), float(k), float(p), _shape(shape), _f(dtype))
+
+
+@register("_random_generalized_negative_binomial", differentiable=False,
+          aliases=("random_generalized_negative_binomial",))
+def _random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, **_):
+    """GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha))."""
+    k1, k2 = jax.random.split(_key())
+    rate = jax.random.gamma(k1, 1.0 / alpha, _shape(shape)) * (mu * alpha)
+    return jax.random.poisson(k2, rate, _shape(shape)).astype(_f(dtype))
+
+
+@register("_random_randint", differentiable=False, aliases=("random_randint",
+                                                            "randint"))
+def _random_randint(low=0, high=1, shape=None, dtype=None, **_):
+    dt = jnp.int32 if dtype in (None, "None") else jnp.dtype(dtype)
+    return jax.random.randint(_key(), _shape(shape), int(low), int(high), dt)
+
+
+# ------------------------------------------------------------ like samplers
+
+@register("_random_uniform_like", differentiable=False,
+          aliases=("uniform_like",))
+def _random_uniform_like(data, low=0.0, high=1.0, **_):
+    d = jnp.asarray(data)
+    return jax.random.uniform(_key(), d.shape, d.dtype, low, high)
+
+
+@register("_random_normal_like", differentiable=False,
+          aliases=("normal_like",))
+def _random_normal_like(data, loc=0.0, scale=1.0, **_):
+    d = jnp.asarray(data)
+    return loc + scale * jax.random.normal(_key(), d.shape, d.dtype)
+
+
+@register("_random_gamma_like", differentiable=False)
+def _random_gamma_like(data, alpha=1.0, beta=1.0, **_):
+    d = jnp.asarray(data)
+    return beta * jax.random.gamma(_key(), alpha, d.shape, d.dtype)
+
+
+@register("_random_exponential_like", differentiable=False)
+def _random_exponential_like(data, lam=1.0, **_):
+    d = jnp.asarray(data)
+    return jax.random.exponential(_key(), d.shape, d.dtype) / lam
+
+
+@register("_random_poisson_like", differentiable=False)
+def _random_poisson_like(data, lam=1.0, **_):
+    d = jnp.asarray(data)
+    return jax.random.poisson(_key(), lam, d.shape).astype(d.dtype)
+
+
+@register("_random_negative_binomial_like", differentiable=False)
+def _random_negative_binomial_like(data, k=1, p=1.0, **_):
+    d = jnp.asarray(data)
+    return _neg_binomial(_key(), float(k), float(p), d.shape, d.dtype)
+
+
+@register("_random_generalized_negative_binomial_like", differentiable=False)
+def _random_gen_neg_binomial_like(data, mu=1.0, alpha=1.0, **_):
+    d = jnp.asarray(data)
+    k1, k2 = jax.random.split(_key())
+    rate = jax.random.gamma(k1, 1.0 / alpha, d.shape) * (mu * alpha)
+    return jax.random.poisson(k2, rate, d.shape).astype(d.dtype)
+
+
+# ------------------------------------- per-row parameter tensors (sample_*)
+
+def _broadcast_draw(params, shape, draw):
+    """Common frame of the reference's multisample ops
+    (src/operator/random/multisample_op.cc): each element of the parameter
+    tensor yields `shape` draws appended to its own dims."""
+    extra = _shape(shape)
+    ps = [jnp.asarray(p) for p in params]
+    out_shape = ps[0].shape + extra
+    ps = [p.reshape(p.shape + (1,) * len(extra)) for p in ps]
+    return draw(out_shape, *ps)
+
+
+@register("sample_uniform", differentiable=False, aliases=("_sample_uniform",))
+def _sample_uniform(low, high, shape=None, dtype=None, **_):
+    return _broadcast_draw(
+        (low, high), shape,
+        lambda s, lo, hi: lo + (hi - lo) *
+        jax.random.uniform(_key(), s, _f(dtype)))
+
+
+@register("sample_normal", differentiable=False, aliases=("_sample_normal",))
+def _sample_normal(mu, sigma, shape=None, dtype=None, **_):
+    return _broadcast_draw(
+        (mu, sigma), shape,
+        lambda s, m, sg: m + sg * jax.random.normal(_key(), s, _f(dtype)))
+
+
+@register("sample_gamma", differentiable=False, aliases=("_sample_gamma",))
+def _sample_gamma(alpha, beta, shape=None, dtype=None, **_):
+    return _broadcast_draw(
+        (alpha, beta), shape,
+        lambda s, a, b: b * jax.random.gamma(_key(), a, s, _f(dtype)))
+
+
+@register("sample_exponential", differentiable=False,
+          aliases=("_sample_exponential",))
+def _sample_exponential(lam, shape=None, dtype=None, **_):
+    return _broadcast_draw(
+        (lam,), shape,
+        lambda s, l: jax.random.exponential(_key(), s, _f(dtype)) / l)
+
+
+@register("sample_poisson", differentiable=False, aliases=("_sample_poisson",))
+def _sample_poisson(lam, shape=None, dtype=None, **_):
+    return _broadcast_draw(
+        (lam,), shape,
+        lambda s, l: jax.random.poisson(_key(), l, s).astype(_f(dtype)))
+
+
+@register("sample_negative_binomial", differentiable=False,
+          aliases=("_sample_negative_binomial",))
+def _sample_negative_binomial(k, p, shape=None, dtype=None, **_):
+    def draw(s, kk, pp):
+        k1, k2 = jax.random.split(_key())
+        rate = jax.random.gamma(k1, kk, s) * ((1.0 - pp) / pp)
+        return jax.random.poisson(k2, rate, s).astype(_f(dtype))
+    return _broadcast_draw((k, p), shape, draw)
+
+
+@register("sample_generalized_negative_binomial", differentiable=False,
+          aliases=("_sample_generalized_negative_binomial",))
+def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype=None, **_):
+    def draw(s, m, a):
+        k1, k2 = jax.random.split(_key())
+        rate = jax.random.gamma(k1, 1.0 / a, s) * (m * a)
+        return jax.random.poisson(k2, rate, s).astype(_f(dtype))
+    return _broadcast_draw((mu, alpha), shape, draw)
+
+
+@register("sample_multinomial", differentiable=False,
+          aliases=("_sample_multinomial", "multinomial"), num_outputs=-1)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32", **_):
+    """Draw class indices from probability rows
+    (reference sample_multinomial_op.cc).  With get_prob=True also returns
+    the log-likelihood of each draw (the REINFORCE use case)."""
+    p = jnp.asarray(data)
+    n = 1 if shape in (None, ()) else \
+        int(jnp.prod(jnp.asarray(_shape(shape))))
+    logits = jnp.log(jnp.maximum(p, 1e-37))
+    draws = jax.random.categorical(_key(), logits[..., None, :], axis=-1,
+                                   shape=p.shape[:-1] + (n,))
+    out_shape = p.shape[:-1] + _shape(shape) if shape not in (None, ()) \
+        else p.shape[:-1]
+    idx = draws.reshape(out_shape).astype(jnp.dtype(dtype))
+    if not get_prob:
+        return idx
+    lp = jnp.take_along_axis(
+        logits, idx.reshape(p.shape[:-1] + (-1,)).astype(jnp.int32),
+        axis=-1).reshape(out_shape)
+    return idx, lp

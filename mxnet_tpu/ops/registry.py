@@ -121,7 +121,8 @@ def apply_op(op, *inputs, **attrs):
             in_cts = _vjp(cts)
             return tuple(_float0_to_none(c) for c in in_cts)
 
-        _tape.record_node(nd_inputs, outs, vjp_fn, name=op.name)
+        _tape.record_node(nd_inputs, outs, vjp_fn, name=op.name,
+                          primal_fn=pure, primal_multi=multi)
         return outs if multi else outs[0]
 
     out_vals = op.fn(*in_arrays, **attrs)

@@ -1,0 +1,36 @@
+"""FFT ops.
+
+Reference: src/operator/contrib/fft-inl.h / ifft-inl.h — cuFFT C2C over the
+last axis with real input and interleaved (re, im) output, unnormalized in
+both directions (so ifft(fft(x)) == n*x, the cuFFT convention).
+
+TPU-native: jnp.fft lowerings; XLA compiles FFT natively on TPU.  The
+interleaved-pair layout of the reference API is preserved so symbols/models
+using _contrib_fft port unchanged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_contrib_fft", aliases=("fft",))
+def _fft(data, compute_size=128, **_):
+    """Real input (..., n) -> interleaved complex output (..., 2n)."""
+    x = jnp.asarray(data)
+    f = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _ifft(data, compute_size=128, **_):
+    """Interleaved complex input (..., 2n) -> real output (..., n),
+    unnormalized (scaled by n) per the reference's cuFFT convention."""
+    x = jnp.asarray(data)
+    n = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (n, 2)).astype(jnp.float32)
+    z = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(z, axis=-1).real * n
+    return out.astype(x.dtype)

@@ -1,0 +1,239 @@
+"""Spatial sampling and warping ops.
+
+Reference: src/operator/bilinear_sampler.cc, grid_generator.cc,
+spatial_transformer.cc, contrib/deformable_convolution.cc,
+contrib/roi_align.cc, contrib/bilinear_resize.cc, correlation.cc.
+
+TPU-native: all of these reduce to ONE shared differentiable gather —
+``_sample_bilinear`` — expressed with static-shape advanced indexing that XLA
+lowers to vectorized dynamic-gathers; gradients (including w.r.t. the
+sampling coordinates) come from jax's autodiff of the interpolation weights
+instead of the reference's hand-written backward kernels
+(bilinear_sampler-inl.h BilinearSamplerBackward etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _sample_bilinear(data, y, x):
+    """Sample NCHW `data` at float pixel coords y/x of shape (N, *S);
+    returns (N, C, *S).  Points outside the image contribute zero (the
+    reference's zero-padding boundary)."""
+    N, C, H, W = data.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = (y - y0)[:, None]
+    wx = (x - x0)[:, None]
+
+    def corner(yy, xx):
+        ok = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        vals = jax.vmap(lambda img, a, b: img[:, a, b])(data, yc, xc)
+        return vals * ok[:, None].astype(data.dtype)
+
+    v00 = corner(y0, x0)
+    v01 = corner(y0, x0 + 1)
+    v10 = corner(y0 + 1, x0)
+    v11 = corner(y0 + 1, x0 + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _denorm(coord, size):
+    """[-1, 1] normalized -> pixel coordinate."""
+    return (coord + 1.0) * (size - 1) / 2.0
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def _bilinear_sampler(data, grid, cudnn_off=None, **_):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) normalized (x, y) in [-1,1]
+    (reference bilinear_sampler.cc)."""
+    d = jnp.asarray(data)
+    g = jnp.asarray(grid)
+    x = _denorm(g[:, 0], d.shape[3])
+    y = _denorm(g[:, 1], d.shape[2])
+    return _sample_bilinear(d, y, x)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0), **_):
+    """affine: (N,6) params -> sampling grid (N,2,H,W); warp: (N,2,H,W)
+    pixel flow -> normalized grid (reference grid_generator.cc)."""
+    d = jnp.asarray(data)
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        theta = d.reshape(-1, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, src)              # (N,2,HW)
+        return out.reshape(-1, 2, H, W)
+    if transform_type == "warp":
+        N, _, H, W = d.shape
+        base_y, base_x = jnp.meshgrid(jnp.arange(H, dtype=d.dtype),
+                                      jnp.arange(W, dtype=d.dtype),
+                                      indexing="ij")
+        px = base_x + d[:, 0]
+        py = base_y + d[:, 1]
+        nx = 2.0 * px / (W - 1) - 1.0
+        ny = 2.0 * py / (H - 1) - 1.0
+        return jnp.stack([nx, ny], axis=1)
+    raise ValueError("unknown transform_type %r" % transform_type)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=None, **_):
+    """Affine grid from loc (N,6) + bilinear sampling
+    (reference spatial_transformer.cc)."""
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",
+                                                "bilinear_resize_2d"))
+def _bilinear_resize(data, like=None, height=0, width=0, scale_height=None,
+                     scale_width=None, mode="size", **_):
+    """Bilinear resize with align-corners coordinate mapping
+    (reference contrib/bilinear_resize.cc)."""
+    d = jnp.asarray(data)
+    N, C, H, W = d.shape
+    if like is not None and mode == "like":
+        height, width = jnp.asarray(like).shape[2:4]
+    if scale_height is not None:
+        height = int(H * scale_height)
+    if scale_width is not None:
+        width = int(W * scale_width)
+    height, width = int(height), int(width)
+    ys = jnp.linspace(0.0, H - 1.0, height)
+    xs = jnp.linspace(0.0, W - 1.0, width)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    y = jnp.broadcast_to(gy, (N,) + gy.shape)
+    x = jnp.broadcast_to(gx, (N,) + gx.shape)
+    return _sample_bilinear(d, y, x)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign", "roi_align"))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False, **_):
+    """ROI Align (reference contrib/roi_align.cc): average of bilinear
+    samples on a regular sub-grid inside each pooled cell."""
+    d = jnp.asarray(data)
+    r = jnp.asarray(rois)
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    ns = sample_ratio if sample_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+    batch_idx = r[:, 0].astype(jnp.int32)
+    x1 = r[:, 1] * spatial_scale - off
+    y1 = r[:, 2] * spatial_scale - off
+    x2 = r[:, 3] * spatial_scale - off
+    y2 = r[:, 4] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    # sub-sample grid: (ph*ns, pw*ns) points per roi
+    sy = (jnp.arange(ph * ns) + 0.5) / ns    # in pooled-cell units
+    sx = (jnp.arange(pw * ns) + 0.5) / ns
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    y = y1[:, None, None] + gy[None] * (rh / ph)[:, None, None]
+    x = x1[:, None, None] + gx[None] * (rw / pw)[:, None, None]
+    per_roi = d[batch_idx]                   # (R, C, H, W)
+    samp = _sample_bilinear(per_roi, y, x)   # (R, C, ph*ns, pw*ns)
+    R, C = samp.shape[:2]
+    samp = samp.reshape(R, C, ph, ns, pw, ns)
+    return samp.mean(axis=(3, 5))
+
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",
+                                                     "deformable_convolution"))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False, **_):
+    """Deformable convolution v1 (reference
+    contrib/deformable_convolution.cc): per-output-location learned offsets
+    shift each kernel tap's sampling point; taps are gathered with the
+    shared bilinear sampler and contracted with the weights in one einsum
+    (the deformable_im2col + GEMM of the reference, fused)."""
+    d = jnp.asarray(data)
+    w = jnp.asarray(weight)
+    off = jnp.asarray(offset)
+    N, C, H, W = d.shape
+    O, Cg, kh, kw = w.shape
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    base_y = jnp.arange(Ho) * sh - ph
+    base_x = jnp.arange(Wo) * sw - pw
+    gy, gx = jnp.meshgrid(base_y.astype(d.dtype), base_x.astype(d.dtype),
+                          indexing="ij")
+    cols = []
+    cpg = C // dg
+    for g in range(dg):
+        dslice = d[:, g * cpg:(g + 1) * cpg]
+        taps = []
+        for i in range(kh):
+            for j in range(kw):
+                k = i * kw + j
+                oy = off[:, 2 * (g * kh * kw + k)]
+                ox = off[:, 2 * (g * kh * kw + k) + 1]
+                y = gy[None] + i * dh + oy
+                x = gx[None] + j * dw + ox
+                taps.append(_sample_bilinear(dslice, y, x))
+        # (N, cpg, kh*kw, Ho, Wo)
+        cols.append(jnp.stack(taps, axis=2))
+    col = jnp.concatenate(cols, axis=1)      # (N, C, K, Ho, Wo)
+    col = col.reshape(N, C * kh * kw, Ho, Wo)
+    wg = w.reshape(num_group, O // num_group, Cg * kh * kw)
+    colg = col.reshape(N, num_group, (C // num_group) * kh * kw, Ho, Wo)
+    out = jnp.einsum("gok,ngkhw->ngohw", wg, colg)
+    out = out.reshape(N, O, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias).reshape(1, -1, 1, 1)
+    return out
+
+
+@register("Correlation", aliases=("correlation",))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True, **_):
+    """Correlation layer (reference correlation.cc, FlowNet-style):
+    out[:, k, y, x] = mean_c data1[:, c, y, x] · data2[:, c, y+dy, x+dx]
+    over the displacement grid k=(dy, dx)."""
+    a = jnp.asarray(data1)
+    b = jnp.asarray(data2)
+    if kernel_size != 1:
+        raise NotImplementedError("Correlation: kernel_size>1 not supported")
+    ps = pad_size
+    ap = jnp.pad(a, ((0, 0), (0, 0), (ps, ps), (ps, ps)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (ps, ps), (ps, ps)))
+    N, C, Hp, Wp = ap.shape
+    disp = max_displacement
+    steps = 2 * (disp // stride2) + 1
+    Ho = (Hp - 2 * disp) // stride1
+    Wo = (Wp - 2 * disp) // stride1
+    ys = disp + jnp.arange(Ho) * stride1
+    xs = disp + jnp.arange(Wo) * stride1
+    out = []
+    for dy in range(-disp, disp + 1, stride2):
+        for dx in range(-disp, disp + 1, stride2):
+            a_c = ap[:, :, disp:disp + Ho * stride1:stride1,
+                     disp:disp + Wo * stride1:stride1]
+            b_c = bp[:, :, disp + dy:disp + dy + Ho * stride1:stride1,
+                     disp + dx:disp + dx + Wo * stride1:stride1]
+            if is_multiply:
+                out.append((a_c * b_c).mean(axis=1))
+            else:
+                out.append(jnp.abs(a_c - b_c).mean(axis=1))
+    del ys, xs
+    return jnp.stack(out, axis=1).reshape(N, steps * steps, Ho, Wo)

@@ -556,3 +556,142 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
     out = jnp.take_along_axis(
         mv, src.reshape(src.shape + (1,) * (mv.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+# ------------------------------------------------- round-3 coverage widening
+# Reference: src/operator/tensor/matrix_op.cc (depth/space reshuffles,
+# cumulative ops), broadcast_reduce_op_value.cc, init_op.cc (creation ops),
+# ravel.cc, loss_binary_op.cc.
+
+@register("cumsum", aliases=("_np_cumsum",))
+def _cumsum(a, axis=None, dtype=None, **_):
+    return jnp.cumsum(jnp.asarray(a), axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def _cumprod(a, axis=None, dtype=None, **_):
+    return jnp.cumprod(jnp.asarray(a), axis=axis, dtype=dtype)
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size=1, **_):
+    """(N, C*b*b, H, W) -> (N, C, H*b, W*b) (reference matrix_op.cc)."""
+    x = jnp.asarray(data)
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size=1, **_):
+    x = jnp.asarray(data)
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("batch_take")
+def _batch_take(a, indices, **_):
+    """out[i] = a[i, indices[i]] (reference indexing_op.cc batch_take)."""
+    x = jnp.asarray(a)
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return jnp.take_along_axis(x, idx.reshape(-1, 1), axis=1)[:, 0]
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None, **_):
+    x = jnp.asarray(lhs)
+    like = jnp.asarray(rhs)
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, like.shape)
+    shape = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = like.shape[ra]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, **_):
+    return jnp.asarray(lhs).reshape(jnp.asarray(rhs).shape)
+
+
+@register("digamma")
+def _digamma(a, **_):
+    return jax.scipy.special.digamma(jnp.asarray(a))
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False, **_):
+    """(mean, variance) over `axes` (reference nn/moments.cc)."""
+    x = jnp.asarray(data)
+    ax = tuple(axes) if axes is not None else None
+    return (jnp.mean(x, axis=ax, keepdims=keepdims),
+            jnp.var(x, axis=ax, keepdims=keepdims))
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data, **_):
+    return jnp.argmax(jnp.asarray(data), axis=1).astype(jnp.float32)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape=None, **_):
+    idx = jnp.asarray(data).astype(jnp.int32)
+    coords = jnp.unravel_index(idx, tuple(shape))
+    return jnp.stack(coords, axis=0)
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=None, **_):
+    coords = jnp.asarray(data).astype(jnp.int32)
+    mult = []
+    acc = 1
+    for s in reversed(tuple(shape)):
+        mult.append(acc)
+        acc *= s
+    mult = jnp.asarray(list(reversed(mult)), jnp.int32)
+    return jnp.sum(coords * mult.reshape(-1, *([1] * (coords.ndim - 1))),
+                   axis=0).astype(jnp.float32)
+
+
+# creation ops (reference: src/operator/tensor/init_op.cc registry names)
+
+@register("_zeros", differentiable=False, aliases=("zeros",))
+def _zeros_op(shape=None, dtype="float32", **_):
+    return jnp.zeros(shape if shape is not None else (), jnp.dtype(dtype))
+
+
+@register("_ones", differentiable=False, aliases=("ones",))
+def _ones_op(shape=None, dtype="float32", **_):
+    return jnp.ones(shape if shape is not None else (), jnp.dtype(dtype))
+
+
+@register("_full", differentiable=False, aliases=("full",))
+def _full_op(shape=None, value=0.0, dtype="float32", **_):
+    return jnp.full(shape if shape is not None else (), value,
+                    jnp.dtype(dtype))
+
+
+@register("_arange", differentiable=False, aliases=("arange",))
+def _arange_op(start=0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", differentiable=False, aliases=("linspace",))
+def _linspace_op(start=0, stop=None, num=50, endpoint=True, dtype="float32",
+                 **_):
+    return jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=jnp.dtype(dtype))
+
+
+@register("_eye", differentiable=False, aliases=("eye",))
+def _eye_op(N=0, M=0, k=0, dtype="float32", **_):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k),
+                   dtype=jnp.dtype(dtype))

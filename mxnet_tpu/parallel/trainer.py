@@ -60,7 +60,7 @@ class SPMDTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, batch_axis="dp", param_specs=None,
-                 donate=True):
+                 donate=True, dtype=None):
         from .. import optimizer as opt_mod
         self.fn = functionalize(block)
         self.block = block
@@ -68,6 +68,16 @@ class SPMDTrainer:
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self.optimizer = optimizer
+        # Mixed-precision compute policy (reference analog: mx.amp bf16 —
+        # python/mxnet/contrib/amp/).  dtype='bfloat16' keeps f32 MASTER
+        # weights and optimizer state, but runs forward+backward in bf16 so
+        # matmuls/convs hit the MXU at its native rate.  The cast is part of
+        # the jitted step, so grads flow through it back to f32 masters
+        # (the standard multi-precision recipe; no loss scaling needed —
+        # bf16 shares f32's exponent range).
+        self.compute_dtype = (jnp.bfloat16 if str(dtype) in
+                              ("bfloat16", "bf16") else None) \
+            if dtype is not None else None
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.batch_axis = batch_axis if batch_axis in self.mesh.axis_names \
             else self.mesh.axis_names[0]
@@ -127,10 +137,21 @@ class SPMDTrainer:
         param_sh = {n: NamedSharding(mesh, self._spec_for(n))
                     for n in fn.params}
 
+        cdt = self.compute_dtype
+
         def loss_of(train_params, aux_params, data, label, key):
-            param_map = dict(aux_params)
-            param_map.update(train_params)
+            param_map = dict(aux_params)  # aux (BN stats) stay f32
+            if cdt is not None:
+                param_map.update(
+                    {n: v.astype(cdt) if v.dtype == jnp.float32 else v
+                     for n, v in train_params.items()})
+                if data.dtype == jnp.float32:  # int inputs (token ids) keep
+                    data = data.astype(cdt)    # their dtype
+            else:
+                param_map.update(train_params)
             (out,), new_aux = fn.apply(param_map, (data,), key, training=True)
+            if cdt is not None:
+                out = out.astype(jnp.float32)
             loss = _as_scalar_loss(loss_fn, out, label)
             return loss, (new_aux, out)
 

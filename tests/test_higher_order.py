@@ -1,0 +1,118 @@
+"""Higher-order gradients through the tape (create_graph=True).
+
+Reference contract: tests/python/unittest/test_higher_order_grad.py —
+autograd.grad(..., create_graph=True, retain_graph=True) returns heads whose
+own backward produces the next derivative order.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _x(vals):
+    x = mx.nd.array(np.asarray(vals, np.float32))
+    x.attach_grad()
+    return x
+
+
+def test_grad_of_grad_sin():
+    xv = np.array([0.3, -1.1, 2.0], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = mx.nd.sin(x)
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(gx.asnumpy(), np.cos(xv), rtol=1e-5)
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(xv), rtol=1e-5)
+
+
+def test_grad_of_grad_log():
+    xv = np.array([0.5, 1.7, 3.2], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = mx.nd.log(x)
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(gx.asnumpy(), 1.0 / xv, rtol=1e-5)
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -1.0 / xv ** 2, rtol=1e-5)
+
+
+def test_grad_of_grad_sigmoid():
+    xv = np.array([-2.0, 0.25, 1.5], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = mx.nd.sigmoid(x)
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+    s = 1.0 / (1.0 + np.exp(-xv))
+    np.testing.assert_allclose(gx.asnumpy(), s * (1 - s), rtol=1e-5)
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               s * (1 - s) * (1 - 2 * s), rtol=1e-4)
+
+
+def test_third_order_cubic():
+    """d3/dx3 of x^3 == 6 — exercises the recursive create_graph path."""
+    xv = np.array([0.7, -1.3], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+        g2 = autograd.grad(g1, x, create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(g1.asnumpy(), 3 * xv ** 2, rtol=1e-5)
+    np.testing.assert_allclose(g2.asnumpy(), 6 * xv, rtol=1e-5)
+    g2.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0], rtol=1e-5)
+
+
+def test_second_order_through_reduction():
+    """grad of (grad of sum(x*x)) — mixes elementwise and reduce nodes."""
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = (x * x).sum()
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+        z = (gx * gx).sum()     # z = sum(4 x^2); dz/dx = 8x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 8 * xv, rtol=1e-5)
+
+
+def test_detached_grad_treated_as_constant():
+    """Without create_graph the returned grad is DETACHED: re-recording on
+    it must treat it as a constant w.r.t. the original input (d(g*x)/dx is
+    g, with no d g/dx term) — the documented remedy is create_graph=True.
+    (ADVICE round 2: the silent-zeros failure mode, made deterministic.)"""
+    xv = np.array([0.4, 1.2], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = mx.nd.sin(x)
+    g = autograd.grad(y, x, retain_graph=True)[0]   # detached: cos(x)
+    with autograd.record():
+        z = (g * x).sum()
+    z.backward()
+    # constant-g semantics: dz/dx == g == cos(x), NOT cos(x) - x sin(x)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.cos(xv), rtol=1e-5)
+
+
+def test_create_graph_grad_requires_record_for_next_order():
+    """Differentiating a create_graph grad a second time works even after
+    leaving the record scope (the tape nodes persist)."""
+    xv = np.array([0.9], np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = mx.nd.log(x)
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)[0]
+    g2 = autograd.grad(gx, x, retain_graph=True)[0]
+    np.testing.assert_allclose(g2.asnumpy(), -1.0 / xv ** 2, rtol=1e-5)
+
+
+def test_first_order_unchanged():
+    """grad() without create_graph matches the tape backward() result."""
+    xv = np.random.RandomState(0).randn(4).astype(np.float32)
+    x = _x(xv)
+    with autograd.record():
+        y = (mx.nd.tanh(x) * x).sum()
+    g = autograd.grad(y, x, retain_graph=True)[0]
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), x.grad.asnumpy(), rtol=1e-6)
