@@ -54,6 +54,7 @@ _LAZY = {
     "operator": ".operator",
     "native": ".native",
     "contrib": ".contrib",
+    "deploy": ".deploy",
 }
 
 

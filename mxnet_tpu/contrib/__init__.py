@@ -8,5 +8,8 @@ engine.
 """
 from . import quantization  # noqa: F401
 from .. import amp  # noqa: F401  (mx.contrib.amp parity alias)
+# control-flow ops at their reference location (python/mxnet/ndarray/
+# contrib.py foreach :216, while_loop :340, cond :480)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 
-__all__ = ["quantization", "amp"]
+__all__ = ["quantization", "amp", "foreach", "while_loop", "cond"]

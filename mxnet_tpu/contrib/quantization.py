@@ -5,13 +5,15 @@ Reference: python/mxnet/contrib/quantization.py `quantize_model` (calib_mode
 (src/operator/quantization/quantize_graph_pass.cc) + calibrate.cc (KL
 histogram) + int8 kernels.
 
-TPU-native re-design: quantization is *simulated-affine* (AQT-style):
-tensors carry f32 values quantized to int8 grid (scale per tensor) so the
-MXU's native bf16/int8 matmuls get the same numerics XLA would emit for
-int8, while every op stays a pure jax function.  The graph pass inserts
-quantize/dequantize around compute ops, thresholds come from naive min/max
-or KL-divergence calibration over a calibration iterator — the same three
-calib modes and workflow as the reference.
+TPU-native re-design: quantized FullyConnected/Convolution nodes execute as
+REAL int8 — both operands are rounded onto the int8 grid, contracted with
+``lax.dot_general``/``conv_general_dilated`` at int8 with s32 accumulation
+(the MXU's native int8 path), then rescaled to f32 (ops/contrib.py
+``_contrib_quantized_*``).  The quantize→int8-GEMM→dequantize chain is fused
+inside one pure op so int8 tensors never cross node boundaries and XLA keeps
+them on-chip.  Thresholds come from naive min/max or KL-divergence
+calibration over a calibration iterator — the same calib modes and workflow
+as the reference.
 """
 from __future__ import annotations
 
@@ -105,15 +107,24 @@ def calib_thresholds(activations, mode="entropy", num_bins=4001):
 QUANTIZABLE_OPS = {"FullyConnected", "Convolution"}
 
 
+_QUANTIZED_OP = {"FullyConnected": "_contrib_quantized_fully_connected",
+                 "Convolution": "_contrib_quantized_conv"}
+
+
+def _input_key(x):
+    return x.name if x.kind == "var" else "%s_output" % x.name
+
+
 def _quantize_symbol(sym, thresholds, excluded_names):
-    """Rebuild the DAG inserting simulated int8 quantization on the data and
-    weight inputs of quantizable ops (the quantize_graph_pass.cc analog)."""
-    from ..symbol.symbol import Symbol, Group, _make_op_node
+    """Rebuild the DAG replacing quantizable ops with their REAL int8
+    versions (the quantize_graph_pass.cc analog): FullyConnected /
+    Convolution become _contrib_quantized_* ops that quantize both operands
+    to int8, contract with s32 accumulation on the MXU, and rescale to f32
+    (ops/contrib.py).  A node is only swapped when calibration produced
+    thresholds for BOTH its data and weight inputs."""
+    from ..symbol.symbol import Symbol, Group
 
     memo = {}
-
-    def qnode(x, amax):
-        return _make_op_node("_sim_quant", [x], {"amax": float(amax)})
 
     def rebuild(node):
         if id(node) in memo:
@@ -121,20 +132,21 @@ def _quantize_symbol(sym, thresholds, excluded_names):
         if node.kind == "var":
             out = node
         else:
-            new_inputs = []
-            quantize_me = node.op in QUANTIZABLE_OPS and \
-                node.name not in excluded_names
-            for i, x in enumerate(node.inputs):
-                if isinstance(x, Symbol):
-                    x = rebuild(x)
-                    if quantize_me and i <= 1:  # data + weight
-                        key = x.name if x.kind == "var" else \
-                            "%s_output" % x.name
-                        amax = thresholds.get(key)
-                        if amax:
-                            x = qnode(x, amax)
-                new_inputs.append(x)
-            out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
+            new_inputs = [rebuild(x) if isinstance(x, Symbol) else x
+                          for x in node.inputs]
+            op_name = node.op
+            attrs = dict(node.attrs)
+            if node.op in _QUANTIZED_OP and node.name not in excluded_names:
+                keys = [_input_key(x) for x in new_inputs[:2]
+                        if isinstance(x, Symbol)]
+                # weight threshold always exists (from arg_params); a missing
+                # DATA threshold (calib_mode='none') becomes amax_data=0 =
+                # runtime range inside the quantized op
+                if len(keys) == 2 and thresholds.get(keys[1]):
+                    op_name = _QUANTIZED_OP[node.op]
+                    attrs["amax_data"] = float(thresholds.get(keys[0], 0.0))
+                    attrs["amax_weight"] = float(thresholds[keys[1]])
+            out = Symbol(node.kind, node.name, op_name, attrs,
                          new_inputs, node.index)
             out._attr_map = dict(node._attr_map)
         memo[id(node)] = out
@@ -165,12 +177,17 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         # tap every quantizable op's data input by evaluating internals
         internals = sym.get_internals()
         want = []
+        input_vars = []
         for node in _topo(sym):
             if node.kind == "op" and node.op in QUANTIZABLE_OPS:
                 x = node.inputs[0]
-                if hasattr(x, "kind") and x.kind != "var":
-                    want.append("%s_output" % x.name)
+                if hasattr(x, "kind"):
+                    if x.kind != "var":
+                        want.append("%s_output" % x.name)
+                    else:
+                        input_vars.append(x.name)
         want = sorted(set(want))
+        input_vars = set(input_vars)
         taps = {}
         seen = 0
         mod_outputs = [internals[n] for n in want] if want else []
@@ -188,6 +205,20 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                 mod.forward(batch, is_train=False)
                 for name, out in zip(want, mod.get_outputs()):
                     taps.setdefault(name, []).append(out.asnumpy())
+                for dname, d in zip(data_names, batch.data):
+                    if dname in input_vars:
+                        taps.setdefault(dname, []).append(d.asnumpy())
+                seen += batch.data[0].shape[0]
+                if num_calib_examples and seen >= num_calib_examples:
+                    break
+            calib_data.reset()
+        elif input_vars:
+            # quantizable ops fed directly by graph inputs: calibrate the
+            # input ranges from the calibration batches alone
+            for batch in calib_data:
+                for dname, d in zip(data_names, batch.data):
+                    if dname in input_vars:
+                        taps.setdefault(dname, []).append(d.asnumpy())
                 seen += batch.data[0].shape[0]
                 if num_calib_examples and seen >= num_calib_examples:
                     break

@@ -1,0 +1,125 @@
+"""``mx.deploy`` — StableHLO model export / import.
+
+Reference deployment surface: the C predict API
+(include/mxnet/c_predict_api.h — load symbol.json + params, run inference
+from any process) and ONNX export (python/mxnet/contrib/onnx/).
+
+TPU-native re-design: the portable artifact is a serialized StableHLO
+program (jax.export) plus a params .npz — the compiler IR *is* the exchange
+format, so a fresh process (or a non-Python XLA runtime: C++ PjRt, IFRT
+serving) can reload and execute without the framework, which is exactly the
+role c_predict_api.cc plays for the reference.  Versioned serialization and
+cross-platform lowering come from jax.export's calling convention.
+
+Artifact layout for ``export_model(prefix)``:
+  {prefix}-model.stablehlo   serialized StableHLO with embedded vjp-free
+                             inference function (params are arguments)
+  {prefix}-params.npz        parameter arrays in call order
+  {prefix}-meta.json         input signature + param names
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as _np
+
+__all__ = ["export_model", "load_model", "StableHLOPredictor"]
+
+
+def export_model(block, prefix, example_input, include_params=True):
+    """Serialize a Gluon block's inference function to StableHLO.
+
+    The exported program is a pure function ``f(params..., data)`` traced at
+    the example input's shape/dtype; parameters ship alongside in an .npz.
+    Returns the list of written paths.
+    """
+    import jax
+    from jax import export as jexport
+    import jax.numpy as jnp
+    from .parallel.functional import functionalize
+    from .ndarray.ndarray import NDArray
+
+    data = example_input._data if isinstance(example_input, NDArray) \
+        else jnp.asarray(example_input)
+
+    # resolve deferred shapes with one eager forward
+    from .ndarray.ndarray import _wrap
+    block(_wrap(data))
+    fn = functionalize(block)
+    names = list(fn.params)
+    values = [jnp.asarray(v) for v in fn.init_values().values()]
+
+    def infer(params, x):
+        param_map = dict(zip(names, params))
+        # fixed key: inference draws nothing (training=False), and pulling
+        # the global eager RNG inside jax.export tracing would leak a
+        # tracer into the host-side key state
+        (out,), _ = fn.apply(param_map, (x,), key=jax.random.PRNGKey(0),
+                             training=False)
+        return out
+
+    jitted = jax.jit(infer)
+    spec = (
+        tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in values),
+        jax.ShapeDtypeStruct(data.shape, data.dtype),
+    )
+    exp = jexport.export(jitted)(*spec)
+    paths = []
+    hlo_path = prefix + "-model.stablehlo"
+    with open(hlo_path, "wb") as f:
+        f.write(exp.serialize())
+    paths.append(hlo_path)
+    meta = {
+        "param_names": names,
+        "input_shape": list(data.shape),
+        "input_dtype": str(data.dtype),
+        "format_version": 1,
+    }
+    meta_path = prefix + "-meta.json"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    paths.append(meta_path)
+    if include_params:
+        params_path = prefix + "-params.npz"
+        _np.savez(params_path,
+                  **{n: _np.asarray(v) for n, v in zip(names, values)})
+        paths.append(params_path)
+    return paths
+
+
+class StableHLOPredictor:
+    """Reloaded inference program (the MXPredCreate/MXPredForward analog:
+    include/mxnet/c_predict_api.h)."""
+
+    def __init__(self, prefix):
+        from jax import export as jexport
+        with open(prefix + "-model.stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(prefix + "-meta.json") as f:
+            self.meta = json.load(f)
+        params_path = prefix + "-params.npz"
+        self._params = None
+        if os.path.exists(params_path):
+            loaded = _np.load(params_path)
+            self._params = tuple(loaded[n]
+                                 for n in self.meta["param_names"])
+
+    def predict(self, data, params=None):
+        """Run inference; returns a host numpy array."""
+        import jax.numpy as jnp
+        from .ndarray.ndarray import NDArray
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        ps = params if params is not None else self._params
+        if ps is None:
+            raise ValueError("no params: artifact exported with "
+                             "include_params=False and none were given")
+        out = self._exported.call(tuple(jnp.asarray(p) for p in ps), x)
+        return _np.asarray(out)
+
+    def forward(self, data):
+        return self.predict(data)
+
+
+def load_model(prefix):
+    return StableHLOPredictor(prefix)

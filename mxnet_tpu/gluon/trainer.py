@@ -207,7 +207,15 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            updater(i, param.grad(), param.data())
+            grad = param.grad()
+            if getattr(param, "_grad_stype", "default") == "row_sparse":
+                # Embedding(sparse_grad=True) path: expose the tape's dense
+                # scatter-add gradient as row_sparse so the optimizer takes
+                # its lazy row update (reference trainer/kvstore row_sparse
+                # flow, python/mxnet/gluon/trainer.py:305+)
+                from ..ndarray.sparse import dense_to_sparse
+                grad = dense_to_sparse(grad, "row_sparse")
+            updater(i, grad, param.data())
 
     def save_states(self, fname):
         """Saves trainer (optimizer) states to a file

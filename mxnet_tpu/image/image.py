@@ -456,29 +456,39 @@ class ImageIter(DataIter):
         arr = arr.transpose(2, 0, 1)  # HWC -> CHW
         return arr, _np.atleast_1d(_np.asarray(label, _np.float32))
 
-    def next(self):
+    def _batch_samples(self):
+        """One batch of decoded samples: ``([(slot, data, label), ...],
+        pad)`` with the wrap-pad of short final batches applied.  The
+        assembly hook shared with iterators composing over this one
+        (io.ImageDetRecordIter)."""
         n = len(self._keys)
         if self.cur >= n:
             raise StopIteration
-        C, H, W = self.data_shape
-        batch_data = _np.zeros((self.batch_size, C, H, W), _np.float32)
-        batch_label = _np.zeros((self.batch_size, self.label_width),
-                                _np.float32)
-        i = 0
+        out = []
         pad = 0
+        i = 0
         while i < self.batch_size:
             if self.cur >= n:
                 pad = self.batch_size - i
                 for j in range(i, self.batch_size):  # wrap-pad
                     d, l = self._read_sample(j % max(i, 1))
-                    batch_data[j] = d
-                    batch_label[j] = l[:self.label_width]
+                    out.append((j, d, l))
                 break
             d, l = self._read_sample(self.cur)
-            batch_data[i] = d
-            batch_label[i] = l[:self.label_width]
+            out.append((i, d, l))
             self.cur += 1
             i += 1
+        return out, pad
+
+    def next(self):
+        C, H, W = self.data_shape
+        samples, pad = self._batch_samples()
+        batch_data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width),
+                                _np.float32)
+        for slot, d, l in samples:
+            batch_data[slot] = d
+            batch_label[slot] = l[:self.label_width]
         label = batch_label[:, 0] if self.label_width == 1 else batch_label
         return DataBatch([_wrap(jnp.asarray(batch_data))],
                          [_wrap(jnp.asarray(label))], pad=pad,

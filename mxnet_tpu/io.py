@@ -24,7 +24,8 @@ from .ndarray.ndarray import NDArray, _wrap
 import jax.numpy as jnp
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "MNISTIter"]
+           "ResizeIter", "PrefetchingIter", "MNISTIter", "LibSVMIter",
+           "ImageDetRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -375,3 +376,181 @@ class PrefetchingIter(DataIter):
             self._exhausted = True
             raise StopIteration
         return item
+
+
+class LibSVMIter(DataIter):
+    """Batches of CSR data parsed from LibSVM text files (reference:
+    src/io/iter_libsvm.cc, registered as LibSVMIter).
+
+    Line format: ``label[,label2,...] idx:val idx:val ...``.  Data batches
+    are ``CSRNDArray`` built per batch from the row slices — the sparse
+    batching of the reference's iter_sparse_batchloader.h.  Labels come from
+    the data file, or from ``label_libsvm`` (itself LibSVM-format sparse
+    labels) when given.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape) if not isinstance(
+            data_shape, int) else (data_shape,)
+        self._round_batch = round_batch
+        rows, labels = self._parse(data_libsvm, self.data_shape[-1])
+        self._rows = rows
+        if label_libsvm is not None:
+            lshape = tuple(label_shape) if label_shape else (1,)
+            lrows, _ = self._parse(label_libsvm, lshape[-1])
+            self._labels = _np.stack([
+                self._densify(r, lshape[-1]) for r in lrows])
+            if lshape == (1,):
+                self._labels = self._labels[:, 0]
+        else:
+            self._labels = _np.asarray(labels, _np.float32)
+        self.cur = 0
+
+    @staticmethod
+    def _parse(path, width):
+        rows = []
+        labels = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0].split(",")[0]))
+                idx = []
+                val = []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows.append((_np.asarray(idx, _np.int32),
+                             _np.asarray(val, _np.float32)))
+        return rows, labels
+
+    @staticmethod
+    def _densify(row, width):
+        out = _np.zeros((width,), _np.float32)
+        idx, val = row
+        out[idx] = val
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self._labels.ndim == 1 else \
+            (self.batch_size,) + self._labels.shape[1:]
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        n = len(self._rows)
+        if self.cur >= n:
+            raise StopIteration
+        take = list(range(self.cur, min(self.cur + self.batch_size, n)))
+        pad = self.batch_size - len(take)
+        if pad and self._round_batch:
+            take += [j % n for j in range(pad)]  # wrap-pad like the reference
+        self.cur += self.batch_size
+        # sparse batching: concatenate row slices into one batch CSR
+        width = self.data_shape[-1]
+        indptr = [0]
+        indices = []
+        values = []
+        for r in take:
+            idx, val = self._rows[r]
+            indices.extend(idx.tolist())
+            values.extend(val.tolist())
+            indptr.append(len(indices))
+        from .ndarray.sparse import CSRNDArray
+        data = CSRNDArray(_np.asarray(values, _np.float32), indptr, indices,
+                          (len(take), width))
+        label = _wrap(jnp.asarray(self._labels[take]))
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection-record iterator (reference:
+    src/io/iter_image_det_recordio.cc ImageDetRecordIter).
+
+    Records are pack_img'ed with a flat float label of layout
+    ``[A, B, extra..., obj0(id, xmin, ymin, xmax, ymax), obj1(...), ...]``
+    where A = header length and B = values per object (the reference's
+    im2rec detection format).  Batch labels are padded with -1 rows to
+    ``label_pad_width`` objects so shapes stay static for jit — the
+    reference pads identically (pad_label_value).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
+                 label_pad_width=-1, label_pad_value=-1.0, shuffle=False,
+                 part_index=0, num_parts=1, aug_list=None, **kwargs):
+        super().__init__(batch_size)
+        from .image.image import ImageIter
+        # reuse the image-record machinery for decode/augment/sharding
+        self._img_iter = ImageIter(
+            batch_size=batch_size, data_shape=data_shape,
+            path_imgrec=path_imgrec, shuffle=shuffle, part_index=part_index,
+            num_parts=num_parts, aug_list=aug_list if aug_list is not None
+            else [], **kwargs)
+        self.data_shape = tuple(data_shape)
+        self._pad_value = float(label_pad_value)
+        if label_pad_width > 0:
+            self._pad_width = label_pad_width
+        else:
+            # scan labels once (headers only — no image decode) so every
+            # batch has the same static label shape for jit
+            from .recordio import unpack
+            width = 1
+            for key in self._img_iter._keys:
+                header, _ = unpack(self._img_iter._rec.read_idx(key))
+                width = max(width, len(self._objects(header.label)))
+            self._pad_width = width
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label", (self.batch_size, self._pad_width, 5))]
+
+    def reset(self):
+        self._img_iter.reset()
+
+    @staticmethod
+    def _objects(flat):
+        flat = _np.asarray(flat, _np.float32).ravel()
+        if flat.size < 2:
+            return _np.zeros((0, 5), _np.float32)
+        header = int(flat[0])
+        owidth = int(flat[1])
+        body = flat[header:]
+        nobj = len(body) // owidth
+        return body[:nobj * owidth].reshape(nobj, owidth)[:, :5]
+
+    def next(self):
+        C, H, W = self.data_shape
+        samples, pad = self._img_iter._batch_samples()
+        batch_data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        width = self._pad_width
+        label = _np.full((self.batch_size, width, 5), self._pad_value,
+                         _np.float32)
+        for slot, d, l in samples:
+            batch_data[slot] = d
+            objs = self._objects(l)
+            m = min(len(objs), width)
+            label[slot, :m] = objs[:m]
+        return DataBatch([_wrap(jnp.asarray(batch_data))],
+                         [_wrap(jnp.asarray(label))], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

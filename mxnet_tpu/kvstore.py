@@ -130,10 +130,30 @@ class KVStore:
             self.pull(key, value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Row-sparse pull; dense fallback gathers the requested rows
-        (reference: kvstore.py:318)."""
+        """Pull ONLY the requested rows of a stored value (reference:
+        kvstore.py:318 / src/kvstore/kvstore_local.h:294 PullRowSparse).
+
+        `out` receives a tensor that is zero everywhere except `row_ids`,
+        whose rows hold the store's current values — the dense image of the
+        row_sparse result (XLA gather does the row selection)."""
         assert out is not None
-        self.pull(key, out, priority)
+        if row_ids is None:
+            self.pull(key, out, priority)
+            return
+        import jax.numpy as jnp
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        keys = key if isinstance(key, (list, tuple)) else [key] * len(outs)
+        ids = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(outs)
+        for k, o, rid in zip(keys, outs, ids):
+            stored = self._store[k]
+            src = stored._data if hasattr(stored, "_data") else \
+                jnp.asarray(stored)
+            rows = jnp.asarray(rid._data if hasattr(rid, "_data")
+                               else rid).astype(jnp.int32).ravel()
+            gathered = jnp.zeros_like(src).at[rows].set(src[rows])
+            o._set_data(gathered.astype(o._data.dtype)) \
+                if hasattr(o, "_set_data") else setattr(o, "_data", gathered)
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -11,11 +11,12 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       linspace, concat, stack, split, where, save, load,
                       waitall, from_jax)
 from .. import random  # noqa: F401 — nd.random.* parity
+from . import sparse  # noqa: F401 — nd.sparse.* (row_sparse/csr) parity
 from ..ops import registry as _registry
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "eye", "linspace", "concat", "stack", "split", "where", "save",
-           "load", "waitall", "random", "from_jax"]
+           "load", "waitall", "random", "sparse", "from_jax"]
 
 
 def zeros_like(data):

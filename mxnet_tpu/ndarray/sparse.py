@@ -111,10 +111,15 @@ def csr_matrix(arg, shape=None, ctx=None, dtype=None):
 
 
 def dense_to_sparse(arr: NDArray, stype: str):
-    a = arr.asnumpy()
     if stype == "row_sparse":
-        nz = _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
-        return RowSparseNDArray(a[nz], nz, a.shape)
+        # stays on device: only the small per-row liveness mask crosses to
+        # host (to fix the row count); values are gathered with jnp — no
+        # full-tensor transfer on the sparse-grad training path
+        d = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+        alive = jnp.any(d.reshape(d.shape[0], -1) != 0, axis=1)
+        nz = _np.where(_np.asarray(alive))[0]
+        return RowSparseNDArray(d[nz], nz, d.shape)
+    a = arr.asnumpy()
     if stype == "csr":
         if a.ndim != 2:
             raise ValueError("csr requires 2-D")
@@ -134,3 +139,55 @@ def zeros(stype, shape, ctx=None, dtype=None):
     import numpy as np
     a = np.zeros(shape, dtype or "float32")
     return dense_to_sparse(_wrap(jnp.asarray(a)), stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware matmul (reference: src/operator/tensor/dot-inl.h sparse
+    paths: csr·dense, csrᵀ·dense, rsp·dense).
+
+    TPU-native: the sparse operand lowers to a jax.experimental.sparse BCOO
+    and the contraction runs as bcoo_dot_general — XLA emits gather/segment
+    ops instead of the reference's per-row CPU/GPU kernels.  Dense operands
+    fall back to jnp.dot.
+    """
+    from jax.experimental import sparse as jsparse
+
+    def _raw(x):
+        return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+    if isinstance(lhs, CSRNDArray):
+        mat = jsparse.BCOO.fromdense(_raw(lhs))
+        if transpose_a:
+            mat = mat.T
+        r = _raw(rhs)
+        if transpose_b:
+            r = r.T
+        return _wrap(mat @ r)
+    if isinstance(lhs, RowSparseNDArray) and not transpose_a:
+        # rows-subset times dense: gather live rows, small matmul, scatter
+        r = _raw(rhs)
+        if transpose_b:
+            r = r.T
+        prod = jnp.dot(lhs._values, r)
+        out = jnp.zeros((lhs.shape[0], r.shape[1]), prod.dtype)
+        return _wrap(out.at[lhs._indices].set(prod))
+    a = _raw(lhs)
+    b = _raw(rhs)
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return _wrap(jnp.dot(a, b))
+
+
+def retain(data, indices):
+    """Keep only the given rows of a row_sparse array (reference op
+    sparse_retain, src/operator/tensor/sparse_retain-inl.h)."""
+    idx = jnp.asarray(indices._data if isinstance(indices, NDArray)
+                      else indices).astype(jnp.int32).ravel()
+    if isinstance(data, RowSparseNDArray):
+        src = data._data
+    else:
+        src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    vals = src[idx]
+    return RowSparseNDArray(vals, idx, src.shape)

@@ -291,3 +291,70 @@ def _sim_quant(data, amax=1.0, **_):
     pattern — keeps every op a pure jax function on MXU-friendly dtypes)."""
     s = 127.0 / max(float(amax), 1e-12)
     return jnp.clip(jnp.round(jnp.asarray(data) * s), -127, 127) / s
+
+
+def _to_int8(x, amax):
+    """Symmetric per-tensor int8.  amax <= 0 means DYNAMIC range: compute
+    |max| from the tensor at runtime (the calib_mode='none' path — reference
+    quantize_v2's min_calib_range-less mode)."""
+    x = jnp.asarray(x)
+    amax = jnp.asarray(amax, jnp.float32)
+    amax = jnp.where(amax > 0, amax,
+                     jnp.max(jnp.abs(x)).astype(jnp.float32))
+    s = 127.0 / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(x * s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), differentiable=False)
+def _quantized_fully_connected(data, weight, bias=None, amax_data=1.0,
+                               amax_weight=1.0, num_hidden=None,
+                               no_bias=False, flatten=True, **_):
+    """REAL int8 dense: both operands quantized to int8, contracted on the
+    MXU with s32 accumulation, rescaled back to f32 (reference:
+    src/operator/quantization/quantized_fully_connected.cc; the quantize ->
+    int8 GEMM -> dequantize chain is fused into one op here so XLA keeps the
+    int8 tensors internal)."""
+    x = jnp.asarray(data)
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    xq, sx = _to_int8(x, amax_data)
+    wq, sw = _to_int8(weight, amax_weight)
+    # contract x's LAST axis with w's input axis — same semantics as the
+    # dense FC (ops/nn.py jnp.dot(x, w.T)) for flatten=False ndim>2 inputs
+    acc = lax.dot_general(xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (sx * sw)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",),
+          differentiable=False)
+def _quantized_conv(data, weight, bias=None, amax_data=1.0, amax_weight=1.0,
+                    kernel=None, stride=None, dilate=None, pad=None,
+                    num_filter=None, num_group=1, no_bias=False, layout=None,
+                    **_):
+    """REAL int8 convolution with s32 accumulation (reference:
+    src/operator/quantization/quantized_conv.cu)."""
+    x = jnp.asarray(data)
+    w = jnp.asarray(weight)
+    ndim = x.ndim - 2
+    from .nn import _tup, _conv_dims
+    stride = _tup(stride, ndim)
+    dilate = _tup(dilate, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    pad = pad if isinstance(pad[0], tuple) else tuple((p, p) for p in pad)
+    xq, sx = _to_int8(x, amax_data)
+    wq, sw = _to_int8(w, amax_weight)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
+    acc = lax.conv_general_dilated(
+        xq, wq, window_strides=stride, padding=pad, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (sx * sw)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
+    return out

@@ -190,6 +190,20 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         t = self._index_update_count[index]
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and \
+                getattr(self, "lazy_update", False) and \
+                hasattr(self, "step_rows"):
+            # lazy sparse update (reference optimizer.py:524+): ONLY the
+            # rows present in the gradient are touched — stale rows see no
+            # weight decay and no momentum decay
+            rows = grad._indices
+            vals = self._preprocess_grad(grad._values)
+            new_w, new_state = self.step_rows(
+                weight._data, rows, vals, _state_data(state), lr, wd, t)
+            weight._set_data(jnp.asarray(new_w, dtype=weight._data.dtype))
+            _state_write(state, new_state)
+            return
         g = self._preprocess_grad(grad._data)
         new_w, new_state = self.step(weight._data, g, _state_data(state),
                                      lr, wd, t)
@@ -285,6 +299,16 @@ class SGD(Optimizer):
             return weight - lr * g, None
         mom = self.momentum * state + lr * g
         return weight - mom, mom
+
+    def step_rows(self, weight, rows, grad_rows, state, lr, wd, t):
+        """Lazy row_sparse step: touch ONLY `rows` (reference
+        optimizer.py:524 sgd lazy_update via sgd_update(lazy_update=True))."""
+        g = grad_rows + wd * weight[rows]
+        if self.momentum == 0.0:
+            return weight.at[rows].add(-lr * g), None
+        mom_rows = self.momentum * state[rows] + lr * g
+        return (weight.at[rows].add(-mom_rows),
+                state.at[rows].set(mom_rows))
 
 
 @register
@@ -533,6 +557,20 @@ class Adam(Optimizer):
         v = self.beta2 * v + (1.0 - self.beta2) * g * g
         w = weight - lr_t * m / (jnp.sqrt(v) + self.epsilon)
         return w, (m, v)
+
+    def step_rows(self, weight, rows, grad_rows, state, lr, wd, t):
+        """Lazy row_sparse Adam: moments and weights update ONLY on `rows`
+        (reference optimizer.py:1371 adam lazy_update)."""
+        m, v = state
+        g = grad_rows + wd * weight[rows]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        m_rows = self.beta1 * m[rows] + (1.0 - self.beta1) * g
+        v_rows = self.beta2 * v[rows] + (1.0 - self.beta2) * g * g
+        w = weight.at[rows].add(
+            -lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon))
+        return w, (m.at[rows].set(m_rows), v.at[rows].set(v_rows))
 
 
 @register

@@ -84,6 +84,51 @@ def _record(kind, name, t_start, t_end, args=None):
                         "dur": t_end - t_start, "args": args or {}})
 
 
+def _latest_trace_file(trace_dir):
+    """Newest Chrome-trace export inside a jax.profiler trace dir."""
+    import glob
+    files = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def device_op_events(trace_dir=None):
+    """Parse the captured trace into per-DEVICE-op timing events.
+
+    Returns {op_name: [durations_in_seconds]} from trace processes whose
+    name marks a device plane ("/device:TPU:0" etc.) — the data the
+    reference's aggregate_stats.cc collects from kernel timestamps.  Host
+    python threads are excluded.  Empty dict when no device plane exists
+    (e.g. CPU backend, which exports only host tracing).
+    """
+    import glob
+    import gzip
+
+    trace_dir = trace_dir or _STATE.get("trace_dir")
+    if not trace_dir:
+        return {}
+    path = _latest_trace_file(trace_dir)
+    if path is None:
+        return {}
+    with gzip.open(path) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    device_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = e.get("args", {}).get("name", "")
+            if "/device:" in pname.lower() or pname.startswith("TPU") or \
+                    "accelerator" in pname.lower():
+                device_pids.add(e["pid"])
+    out = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in device_pids:
+            name = e.get("name", "")
+            if name:
+                out.setdefault(name, []).append(e.get("dur", 0) / 1e6)
+    return out
+
+
 def dump(finished=True, profile_process="worker"):
     """Write host-side events as Chrome tracing JSON next to the XPlane dir
     (reference: DumpProfile, src/profiler/profiler.h:299)."""
@@ -98,28 +143,53 @@ def dump(finished=True, profile_process="worker"):
     return _CONFIG["filename"]
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate per-name stats table (reference: aggregate_stats.cc)."""
-    with _EVENTS_LOCK:
-        events = list(_EVENTS)
-        if reset:
-            _EVENTS.clear()
+def _stats_rows(samples):
+    """name -> list[seconds] into aggregate rows."""
     agg = {}
-    for e in events:
-        s = agg.setdefault(e["name"], {"count": 0, "total": 0.0,
-                                       "min": float("inf"), "max": 0.0})
-        s["count"] += 1
-        s["total"] += e["dur"]
-        s["min"] = min(s["min"], e["dur"])
-        s["max"] = max(s["max"], e["dur"])
+    for name, durs in samples.items():
+        agg[name] = {"count": len(durs), "total": sum(durs),
+                     "min": min(durs), "max": max(durs)}
+    return agg
+
+
+def _format_table(agg, title, sort_by, ascending):
     rows = sorted(agg.items(), key=lambda kv: kv[1][sort_by],
                   reverse=not ascending)
-    lines = ["%-40s %8s %12s %12s %12s" % ("Name", "Calls", "Total(ms)",
+    lines = [title,
+             "%-40s %8s %12s %12s %12s" % ("Name", "Calls", "Total(ms)",
                                            "Min(ms)", "Max(ms)")]
     for name, s in rows:
         lines.append("%-40s %8d %12.3f %12.3f %12.3f"
                      % (name[:40], s["count"], s["total"] * 1e3,
                         s["min"] * 1e3, s["max"] * 1e3))
+    return lines
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats table (reference: aggregate_stats.cc).
+
+    Two sections: DEVICE ops parsed from the captured jax.profiler trace
+    (per-XLA-op kernel times on the TPU — the question "which op is slow on
+    device") followed by host-side facade events (Task/Frame/scope).  The
+    device section is present whenever a trace with a device plane was
+    captured between start() and stop().
+    """
+    with _EVENTS_LOCK:
+        events = list(_EVENTS)
+        if reset:
+            _EVENTS.clear()
+    lines = []
+    dev = device_op_events()
+    if dev:
+        lines += _format_table(_stats_rows(dev),
+                               "Device ops (from XLA trace)", sort_by,
+                               ascending)
+        lines.append("")
+    host = {}
+    for e in events:
+        host.setdefault(e["name"], []).append(e["dur"])
+    lines += _format_table(_stats_rows(host) if host else {},
+                           "Host events", sort_by, ascending)
     return "\n".join(lines)
 
 

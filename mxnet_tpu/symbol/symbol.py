@@ -385,6 +385,8 @@ def _auto_name(opname):
 _OP_INPUT_SLOTS = {
     "FullyConnected": ("data", "weight", "bias"),
     "Convolution": ("data", "weight", "bias"),
+    "_contrib_quantized_fully_connected": ("data", "weight", "bias"),
+    "_contrib_quantized_conv": ("data", "weight", "bias"),
     "Deconvolution": ("data", "weight", "bias"),
     "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
     "LayerNorm": ("data", "gamma", "beta"),
@@ -482,6 +484,8 @@ _SHAPE_TRANSPARENT = {"cast", "_sim_quant", "identity", "BlockGrad",
 _PARAM_SHAPE_RULES = {
     "FullyConnected": _fc_param_shapes,
     "Convolution": _conv_param_shapes,
+    "_contrib_quantized_fully_connected": _fc_param_shapes,
+    "_contrib_quantized_conv": _conv_param_shapes,
     "Deconvolution": _deconv_param_shapes,
     "BatchNorm": _bn_param_shapes,
     "LayerNorm": _ln_param_shapes,

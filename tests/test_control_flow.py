@@ -1,0 +1,183 @@
+"""Control-flow ops: foreach / while_loop / cond — forward and gradients,
+eager (taped Python loop) and hybridized/jit (lax.scan / lax.while_loop /
+lax.cond lowering).
+
+Reference: tests/python/unittest/test_contrib_control_flow.py.
+"""
+import numpy as np
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.ops.control_flow import foreach, while_loop, cond
+
+
+def test_foreach_forward_eager():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.array(np.zeros(3, np.float32))
+
+    def body(x, s):
+        new_s = s + x
+        return new_s * 2, new_s
+
+    outs, final = foreach(body, data, init)
+    host = np.arange(12, dtype=np.float32).reshape(4, 3)
+    cums = np.cumsum(host, axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), cums * 2, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), cums[-1], rtol=1e-6)
+
+
+def test_foreach_traced_matches_eager():
+    """Same body through lax.scan (outside record) equals the eager loop."""
+    host = np.random.RandomState(0).randn(5, 2).astype(np.float32)
+    init_h = np.ones(2, np.float32)
+
+    def body(x, s):
+        return x * s, s + x
+
+    with autograd.record():  # eager (taped) path
+        o1, f1 = foreach(body, mx.nd.array(host), mx.nd.array(init_h))
+    o2, f2 = foreach(body, mx.nd.array(host), mx.nd.array(init_h))
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(f1.asnumpy(), f2.asnumpy(), rtol=1e-6)
+
+
+def test_foreach_gradient():
+    host = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    data = mx.nd.array(host)
+    data.attach_grad()
+
+    def body(x, s):
+        return x * x, s + x
+
+    with autograd.record():
+        outs, final = foreach(body, data, mx.nd.zeros((3,)))
+        loss = outs.sum() + (final * final).sum()
+    loss.backward()
+    total = host.sum(axis=0)
+    expect = 2 * host + np.tile(2 * total, (4, 1))
+    np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = mx.nd.array(np.ones((3, 2), np.float32))
+
+    def body(x, states):
+        a, b = states
+        return [x + a, x * b], [a + 1, b * 2]
+
+    outs, states = foreach(body, data,
+                           [mx.nd.zeros((2,)), mx.nd.ones((2,))])
+    assert outs[0].shape == (3, 2) and outs[1].shape == (3, 2)
+    np.testing.assert_allclose(states[0].asnumpy(), [3, 3])
+    np.testing.assert_allclose(states[1].asnumpy(), [8, 8])
+
+
+def test_while_loop_forward():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body(i, s):
+        return s + i, (i + 1, s + i)
+
+    outs, (i_f, s_f) = while_loop(
+        cond_fn, body,
+        (mx.nd.array([0.0]), mx.nd.array([0.0])), max_iterations=10)
+    # i runs 0..4, s accumulates 0+0,+1,+2,+3,+4 = 10
+    assert float(i_f.asscalar()) == 5.0
+    assert float(s_f.asscalar()) == 10.0
+
+
+def test_while_loop_gradient_eager():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+
+    def cond_fn(i, v):
+        return i < 3
+
+    def body(i, v):
+        return v, (i + 1, v * x)
+
+    with autograd.record():
+        outs, (_, v_f) = while_loop(
+            cond_fn, body, (mx.nd.array([0.0]), x), max_iterations=5)
+        loss = v_f.sum()   # v_f = x^4
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4 * 2.0 ** 3], rtol=1e-5)
+
+
+def test_while_loop_traced_masking():
+    """Outside record: fixed-trip scan with predicate masking must stop
+    updating loop vars once the predicate fails."""
+    def cond_fn(i, s):
+        return i < 3
+
+    def body(i, s):
+        return s, (i + 1, s * 2)
+
+    outs, (i_f, s_f) = while_loop(
+        cond_fn, body, (mx.nd.array([0.0]), mx.nd.array([1.0])),
+        max_iterations=8)
+    assert float(i_f.asscalar()) == 3.0
+    assert float(s_f.asscalar()) == 8.0
+
+
+def test_cond_both_branches_and_grad():
+    x = mx.nd.array([1.5])
+    x.attach_grad()
+    with autograd.record():
+        out = cond(mx.nd.array([1.0]),
+                   lambda: x * 2, lambda: x * 3)
+        out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    with autograd.record():
+        out = cond(mx.nd.array([0.0]),
+                   lambda: x * 2, lambda: x * 3)
+        out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_cond_traced():
+    out = cond(mx.nd.array([1.0]),
+               lambda: mx.nd.array([10.0]), lambda: mx.nd.array([20.0]))
+    assert float(out.asscalar()) == 10.0
+    out = cond(mx.nd.array([0.0]),
+               lambda: mx.nd.array([10.0]), lambda: mx.nd.array([20.0]))
+    assert float(out.asscalar()) == 20.0
+
+
+def test_foreach_inside_hybridized_block():
+    """Control flow inside a hybridized (jit) block lowers via lax.scan and
+    matches the eager result bitwise-ish."""
+    class CumNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            out, final = foreach(lambda e, s: (e + s, e + s), x,
+                                 mx.nd.zeros((2,)))
+            return out
+
+    net = CumNet()
+    host = np.random.RandomState(2).randn(4, 2).astype(np.float32)
+    eager = net(mx.nd.array(host)).asnumpy()
+    net.hybridize()
+    hybrid = net(mx.nd.array(host)).asnumpy()
+    np.testing.assert_allclose(eager, np.cumsum(host, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(hybrid, eager, rtol=1e-6)
+
+
+def test_foreach_rnn_cell_equivalence():
+    """foreach-driven RNN cell == cell.unroll (the reference's canonical
+    control-flow use case)."""
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    host = np.random.RandomState(3).randn(5, 2, 3).astype(np.float32)  # TNC
+    x = mx.nd.array(host)
+
+    def body(x_t, states):
+        out, new_states = cell(x_t, states)
+        return out, new_states
+
+    outs, _ = foreach(body, x, cell.begin_state(batch_size=2))
+    ref_outs, _ = cell.unroll(5, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(outs.asnumpy(), ref_outs.asnumpy(),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,96 @@
+"""StableHLO export/import deployment path (reference analog: C predict API
+include/mxnet/c_predict_api.h + contrib/onnx export).
+
+The headline contract (VERDICT r2 #9): export ResNet-50, reload in a FRESH
+PROCESS, bitwise-equal inference.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import deploy, gluon
+
+
+def _small_net():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"),
+                gluon.nn.GlobalAvgPool2D(),
+                gluon.nn.Dense(4))
+    return net
+
+
+def test_export_reload_same_process(tmp_path):
+    net = _small_net()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 3, 8, 8)
+                    .astype(np.float32))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "m")
+    paths = deploy.export_model(net, prefix, x)
+    assert all(os.path.exists(p) for p in paths)
+    pred = deploy.load_model(prefix)
+    got = pred.predict(x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_resnet50_fresh_process_bitwise(tmp_path):
+    """ResNet-50 exported, reloaded by a brand-new interpreter, compared
+    bitwise against the in-process forward."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet50_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).randn(1, 3, 64, 64)
+                    .astype(np.float32))
+    eager = net(x).asnumpy()
+    prefix = str(tmp_path / "r50")
+    deploy.export_model(net, prefix, x)
+    # the exported compiled program is the deployment artifact: its
+    # in-process output is the bitwise reference; the eager forward agrees
+    # numerically (XLA fusion reorders float rounding)
+    want = deploy.load_model(prefix).predict(x)
+    np.testing.assert_allclose(want, eager, rtol=1e-5, atol=1e-6)
+    np.save(str(tmp_path / "input.npy"), x.asnumpy())
+    np.save(str(tmp_path / "want.npy"), want)
+
+    script = r"""
+import sys, numpy as np
+sys.path.insert(0, %(repo)r)
+from mxnet_tpu import deploy
+pred = deploy.load_model(%(prefix)r)
+x = np.load(%(inp)r)
+got = pred.predict(x)
+want = np.load(%(want)r)
+assert got.dtype == want.dtype and got.shape == want.shape
+assert (got == want).all(), "not bitwise equal: max diff %%g" %% (
+    np.abs(got - want).max())
+print("FRESH_PROCESS_BITWISE_OK")
+""" % {"repo": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+       "prefix": prefix, "inp": str(tmp_path / "input.npy"),
+       "want": str(tmp_path / "want.npy")}
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "FRESH_PROCESS_BITWISE_OK" in out.stdout, \
+        (out.stdout, out.stderr[-2000:])
+
+
+def test_export_without_params_and_external_params(tmp_path):
+    net = _small_net()
+    net.initialize()
+    x = mx.nd.array(np.ones((1, 3, 8, 8), np.float32))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "np")
+    deploy.export_model(net, prefix, x, include_params=False)
+    assert not os.path.exists(prefix + "-params.npz")
+    pred = deploy.load_model(prefix)
+    from mxnet_tpu.parallel.functional import functionalize
+    fn = functionalize(net)
+    params = [np.asarray(v) for v in fn.init_values().values()]
+    got = pred.predict(x, params=params)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

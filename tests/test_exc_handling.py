@@ -1,0 +1,109 @@
+"""Exception-surfacing UX (reference: tests/python/unittest/
+test_exc_handling.py:29-130 — async kernel errors are captured and rethrown
+at wait points, and a failed op must not poison later work).
+
+TPU-native mapping: jax validates shapes/dtypes AT DISPATCH (errors surface
+no later than the reference's contract), while host-callback ops (the custom
+op bridge over jax.pure_callback) run asynchronously — their errors surface
+at the block point (asnumpy/wait_to_read/waitall), exactly the reference's
+var-exception behavior.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_imperative_error_surfaces():
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    b = mx.nd.array(np.ones((4, 5), np.float32))
+    with pytest.raises(Exception):
+        (a + b).wait_to_read()   # incompatible broadcast
+
+
+def test_error_is_not_sticky():
+    """After a failed op, the dispatcher keeps working (reference
+    test_exc_handling: post-exception usability)."""
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    with pytest.raises(Exception):
+        _ = (a + mx.nd.array(np.ones((7, 7)))).asnumpy()
+    out = (a * 2).asnumpy()
+    np.testing.assert_allclose(out, 2 * np.ones((2, 3)))
+    mx.nd.waitall()
+
+
+def test_exc_inside_record_does_not_break_tape():
+    x = mx.nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        with pytest.raises(Exception):
+            _ = mx.nd.dot(x, mx.nd.array(np.ones((5, 5))))  # rank mismatch
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3, 3])
+
+
+class _BoomProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class _Boom(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                raise RuntimeError("boom from custom op")
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                raise RuntimeError("boom backward")
+        return _Boom()
+
+
+def test_async_custom_op_error_surfaces_at_wait_point():
+    """The pure_callback bridge runs the python kernel off the dispatch
+    path; its exception must be delivered at a wait point, not lost
+    (reference exc contract for async engine ops)."""
+    mx.operator.register("__boom_op")(_BoomProp)
+    x = mx.nd.array(np.ones((4,), np.float32))
+    with pytest.raises(Exception):
+        out = mx.nd.Custom(x, op_type="__boom_op")
+        out.asnumpy()   # block point
+
+
+def test_waitall_after_failure_then_recover():
+    x = mx.nd.array(np.ones((4,), np.float32))
+    with pytest.raises(Exception):
+        out = mx.nd.Custom(x, op_type="__boom_op")
+        out.wait_to_read()
+    # engine still alive
+    y = (x + 1).asnumpy()
+    np.testing.assert_allclose(y, 2 * np.ones(4))
+    mx.nd.waitall()
+
+
+def test_naive_engine_synchronous_error():
+    """NaiveEngine debug mode (MXNET_ENGINE_TYPE=NaiveEngine analog) makes
+    every op complete synchronously, so the same error surfaces at the call
+    site — the reference's bisection workflow for scheduling bugs."""
+    from mxnet_tpu import engine
+    prev = engine._STATE.get("naive", False) if hasattr(engine, "_STATE") \
+        else None
+    try:
+        engine.set_engine_type("NaiveEngine")
+        a = mx.nd.array(np.ones((2, 2), np.float32))
+        with pytest.raises(Exception):
+            _ = a + mx.nd.array(np.ones((9, 9)))
+        out = (a * 5).asnumpy()
+        np.testing.assert_allclose(out, 5 * np.ones((2, 2)))
+    finally:
+        engine.set_engine_type("ThreadedEnginePerDevice")

@@ -645,6 +645,16 @@ spec("_contrib_dequantize", inputs=lambda: [
     fwd_only="int8 input")
 spec("_sim_quant", inputs=lambda: [rnd(3, 4)],
      fwd_only="discretization (straight-through estimator)")
+spec("_contrib_quantized_fully_connected",
+     inputs=lambda: [rnd(2, 6), rnd(3, 6)],
+     attrs={"amax_data": 2.0, "amax_weight": 2.0, "no_bias": True},
+     ref=lambda x, w, **_: x @ w.T, rtol=0.05,
+     fwd_only="int8 execution path; value-checked at int8 tolerance")
+spec("_contrib_quantized_conv",
+     inputs=lambda: [rnd(1, 2, 5, 5), rnd(3, 2, 3, 3)],
+     attrs={"amax_data": 2.0, "amax_weight": 2.0, "kernel": (3, 3),
+            "no_bias": True},
+     fwd_only="int8 execution path; accuracy covered in test_contrib")
 
 # MultiBoxTarget/Detection-style ops registered under other names get their
 # own specs here if present; the meta test below catches any addition that

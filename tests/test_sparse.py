@@ -1,0 +1,138 @@
+"""Sparse compute parity (reference: tests/python/unittest/
+test_sparse_operator.py, test_sparse_ndarray.py and the lazy_update
+optimizer paths in python/mxnet/optimizer/optimizer.py:524+)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((5, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 4])
+    np.testing.assert_array_equal(rs.tostype("default").asnumpy(), dense)
+
+
+def test_csr_dot_dense():
+    rng = np.random.RandomState(0)
+    a = rng.randn(6, 8).astype(np.float32)
+    a[a < 0.5] = 0  # sparsify
+    b = rng.randn(8, 4).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    outT = sparse.dot(csr, mx.nd.array(b.T), transpose_b=True)
+    np.testing.assert_allclose(outT.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_row_sparse_dot_dense():
+    rng = np.random.RandomState(1)
+    dense = np.zeros((6, 5), np.float32)
+    dense[[0, 3]] = rng.randn(2, 5)
+    rs = sparse.row_sparse_array(dense)
+    b = rng.randn(5, 3).astype(np.float32)
+    out = sparse.dot(rs, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), dense @ b, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sparse_retain():
+    dense = np.arange(15, dtype=np.float32).reshape(5, 3)
+    rs = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rs, mx.nd.array([0, 3]))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [0, 3])
+    expect = np.zeros_like(dense)
+    expect[[0, 3]] = dense[[0, 3]]
+    np.testing.assert_array_equal(kept.tostype("default").asnumpy(), expect)
+
+
+def test_kvstore_row_sparse_pull_gathers_rows():
+    kv = mx.kv.create("local")
+    w = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((6, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 4]))
+    host = out.asnumpy()
+    np.testing.assert_allclose(host[[1, 4]], w[[1, 4]], rtol=1e-6)
+    assert np.all(host[[0, 2, 3, 5]] == 0), "non-requested rows must be 0"
+
+
+def _embedding_trainer(optimizer, opt_params, vocab=8, dim=3):
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(emb.collect_params(), optimizer, opt_params)
+    return emb, trainer
+
+
+def test_sparse_embedding_sgd_touches_only_live_rows():
+    """The lazy_update contract (reference optimizer.py:524): rows whose ids
+    do not appear in the batch are NOT touched — no weight decay, no
+    momentum decay on stale rows."""
+    emb, trainer = _embedding_trainer(
+        "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.1})
+    w0 = emb.weight.data().asnumpy().copy()
+    ids = mx.nd.array(np.array([1, 3, 3], np.float32))
+    with mx.autograd.record():
+        out = emb(ids)
+        loss = (out * out).sum()
+    loss.backward()
+    trainer.step(3)
+    w1 = emb.weight.data().asnumpy()
+    live = [1, 3]
+    stale = [0, 2, 4, 5, 6, 7]
+    assert np.abs(w1[live] - w0[live]).max() > 1e-6, "live rows must move"
+    # a DENSE update with wd=0.1 would shrink every row; lazy must not
+    np.testing.assert_array_equal(w1[stale], w0[stale])
+
+    # second step with different ids: momentum state of previously-live
+    # rows must not decay rows that are stale THIS step
+    w_before = emb.weight.data().asnumpy().copy()
+    ids2 = mx.nd.array(np.array([0.0], np.float32))
+    with mx.autograd.record():
+        loss = (emb(ids2) * emb(ids2)).sum()
+    loss.backward()
+    trainer.step(1)
+    w2 = emb.weight.data().asnumpy()
+    np.testing.assert_array_equal(w2[[1, 3]], w_before[[1, 3]])
+    assert np.abs(w2[0] - w_before[0]).max() > 1e-6
+
+
+def test_sparse_embedding_adam_converges_and_is_lazy():
+    emb, trainer = _embedding_trainer("adam", {"learning_rate": 0.05})
+    w0 = emb.weight.data().asnumpy().copy()
+    target = np.zeros(3, np.float32)
+    for _ in range(20):
+        ids = mx.nd.array(np.array([2, 5], np.float32))
+        with mx.autograd.record():
+            out = emb(ids)
+            loss = ((out - mx.nd.array(np.tile(target, (2, 1)))) ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+    w = emb.weight.data().asnumpy()
+    stale = [0, 1, 3, 4, 6, 7]
+    np.testing.assert_array_equal(w[stale], w0[stale])
+    assert np.abs(w[[2, 5]]).max() < np.abs(w0[[2, 5]]).max(), \
+        "trained rows should move toward zero"
+
+
+def test_dense_grad_embedding_unchanged():
+    """sparse_grad=False keeps the ordinary dense update path (weight decay
+    applies to every row)."""
+    emb = gluon.nn.Embedding(6, 3, sparse_grad=False)
+    emb.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "wd": 0.5})
+    w0 = emb.weight.data().asnumpy().copy()
+    ids = mx.nd.array(np.array([1], np.float32))
+    with mx.autograd.record():
+        loss = (emb(ids) * emb(ids)).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    # wd shrinks even untouched rows on the dense path
+    assert np.abs(w1[[0, 2, 3, 4, 5]] - w0[[0, 2, 3, 4, 5]]).max() > 1e-7
