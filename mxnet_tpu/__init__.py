@@ -55,6 +55,8 @@ _LAZY = {
     "native": ".native",
     "contrib": ".contrib",
     "deploy": ".deploy",
+    "config": ".config",
+    "library": ".library",
 }
 
 

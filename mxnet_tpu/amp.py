@@ -167,40 +167,45 @@ def convert_symbol(sym, target_dtype="bfloat16", **kwargs):
     """Rebuild the DAG with casts — the graph-recolor analog of the
     reference's low-precision pass (src/nnvm/low_precision_pass.cc): inputs
     of compute ops are cast to the target dtype, inputs of FP32_OPS are cast
-    back to f32, and head outputs are returned in f32."""
-    from .symbol.symbol import Symbol, Group, _topo, _make_op_node
+    back to f32, and head outputs are returned in f32.  Expressed on the
+    pluggable pass machinery (symbol/subgraph.py rewrite_nodes)."""
+    from .symbol.symbol import Symbol, Group, _make_op_node, _INT_DATA_OPS
+    from .symbol.subgraph import rewrite_nodes
 
     dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else \
         "float16"
-    memo = {}
 
     def cast_node(x, dtype):
         return _make_op_node("cast", [x], {"dtype": dtype})
 
-    def rebuild(node):
-        from .symbol.symbol import _INT_DATA_OPS
-        if id(node) in memo:
-            return memo[id(node)]
-        if node.kind == "var":
-            out = node
-        else:
-            new_inputs = []
-            want = "float32" if node.op in FP32_OPS else dt
-            for i, x in enumerate(node.inputs):
-                if isinstance(x, Symbol):
-                    x = rebuild(x)
-                    skip = (i == 0 and node.op in _INT_DATA_OPS)
-                    if node.kind == "op" and x.kind != "slice" and not skip:
-                        x = cast_node(x, want)
-                new_inputs.append(x)
-            out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
-                         new_inputs, node.index)
-            out._attr_map = dict(node._attr_map)
-        memo[id(node)] = out
+    def recolor(node, new_inputs):
+        want = "float32" if node.op in FP32_OPS else dt
+        casted = []
+        for i, x in enumerate(new_inputs):
+            skip = (i == 0 and node.op in _INT_DATA_OPS)
+            if isinstance(x, Symbol) and node.kind == "op" and \
+                    x.kind != "slice" and not skip:
+                x = cast_node(x, want)
+            casted.append(x)
+        out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
+                     casted, node.index)
+        out._attr_map = dict(node._attr_map)
         return out
 
-    heads = [cast_node(rebuild(h), "float32") for h in sym._heads()]
+    recolored = rewrite_nodes(sym, recolor)
+    heads = [cast_node(h, "float32") for h in recolored._heads()]
     return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def _register_amp_pass():
+    from .symbol.subgraph import register_pass
+
+    @register_pass("AMPLowPrecision")
+    def _amp_pass(sym, target_dtype="bfloat16", **kw):
+        return convert_symbol(sym, target_dtype, **kw)
+
+
+_register_amp_pass()
 
 
 def convert_hybrid_block(block, target_dtype="bfloat16", **kwargs):

@@ -1,0 +1,143 @@
+"""``mx.config`` — the typed, documented runtime-knob registry.
+
+Reference: ~80 ``MXNET_*`` environment variables read via dmlc::GetEnv at
+point of use and documented in
+docs/static_site/src/pages/api/faq/env_var.md:43-258 (engine type/threads,
+memory-pool knobs, bulk-exec sizes, kvstore tree/bigarray, profiler
+autostart, cuDNN autotune ...).
+
+TPU-native re-design: one declarative registry.  Every knob has a TYPE, a
+DEFAULT, its ENV VAR, and a DOCSTRING — `mx.config.describe()` prints the
+whole table (the env_var.md property, kept in code so it can't go stale),
+`mx.config.get/set` read and override programmatically, and env variables
+are re-read lazily so launcher scripts keep working.  Knobs whose reference
+meaning is owned by XLA on TPU (memory pools, cuDNN autotune) are documented
+as such rather than silently dropped.
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+__all__ = ["register_knob", "get", "set", "describe", "knobs", "Knob"]
+
+Knob = namedtuple("Knob", ["name", "env", "type", "default", "doc"])
+
+_KNOBS = {}
+_OVERRIDES = {}
+
+
+def register_knob(name, env, type_, default, doc):
+    """Declare a knob.  `env` is its environment variable; `type_` one of
+    bool/int/float/str."""
+    _KNOBS[name] = Knob(name, env, type_, default, doc)
+    return _KNOBS[name]
+
+
+def _parse(knob, raw):
+    if knob.type is bool:
+        return raw not in ("0", "false", "False", "")
+    return knob.type(raw)
+
+
+def get(name):
+    """Current value: programmatic override > env var > default."""
+    knob = _KNOBS[name]
+    if name in _OVERRIDES:
+        return _OVERRIDES[name]
+    raw = os.environ.get(knob.env)
+    if raw is not None:
+        return _parse(knob, raw)
+    return knob.default
+
+
+def set(name, value):  # noqa: A001 — reference-parity name
+    if name not in _KNOBS:
+        raise KeyError("unknown knob %r (see mx.config.describe())" % name)
+    knob = _KNOBS[name]
+    # strings coerce through the same parser as env vars, so
+    # set('x', '0') and ENV_X=0 agree (notably for bools)
+    _OVERRIDES[name] = _parse(knob, value) if isinstance(value, str) \
+        else knob.type(value)
+
+
+def knobs():
+    return dict(_KNOBS)
+
+
+def describe():
+    """The env_var.md table, generated from the registry."""
+    lines = ["%-28s %-34s %-8s %-10s %s" % ("Knob", "Env var", "Type",
+                                            "Default", "Doc")]
+    for k in sorted(_KNOBS.values()):
+        lines.append("%-28s %-34s %-8s %-10s %s"
+                     % (k.name, k.env, k.type.__name__, k.default, k.doc))
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------- the registry
+# engine / dispatch (reference env_var.md:50-68)
+register_knob(
+    "engine.type", "MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+    "NaiveEngine forces synchronous per-op completion (serial debug mode); "
+    "the default maps to jax async dispatch.")
+register_knob(
+    "engine.bulk_size", "MXNET_ENGINE_BULK_SIZE", int, 15,
+    "Reference bulking segment size; informational on TPU — one jitted "
+    "step is a single fused program, bulking has no residual role.")
+
+# distributed rendezvous (parallel/__init__.py)
+register_knob(
+    "dist.coordinator", "MXTPU_COORDINATOR", str, "",
+    "host:port of the jax.distributed coordinator (the ps-lite scheduler "
+    "analog); set by tools/launch.py.")
+register_knob(
+    "dist.num_processes", "MXTPU_NUM_PROCESSES", int, 1,
+    "world size for multi-process jax.distributed runs.")
+register_knob(
+    "dist.process_id", "MXTPU_PROCESS_ID", int, 0,
+    "this process's rank in the multi-process run.")
+
+# profiler (reference env_var.md:201-205)
+register_knob(
+    "profiler.autostart", "MXNET_PROFILER_AUTOSTART", bool, False,
+    "start the profiler at import, mirroring MXNET_PROFILER_AUTOSTART.")
+register_knob(
+    "profiler.filename", "MXNET_PROFILER_FILENAME", str, "profile.json",
+    "default Chrome-trace output path for mx.profiler.dump().")
+
+# kvstore / gradient sync
+register_knob(
+    "kvstore.grad_compression_threshold",
+    "MXTPU_GRAD_COMPRESSION_THRESHOLD", float, 0.5,
+    "threshold for 2-bit gradient compression (kvstore."
+    "set_gradient_compression), reference gradient_compression.cc:44.")
+
+# bench / testing
+register_knob(
+    "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 520.0,
+    "bench.py watchdog in seconds.")
+register_knob(
+    "test.seed", "MXNET_TEST_SEED", int, -1,
+    "fixed seed for test_utils randomness; -1 draws a fresh one "
+    "(reference tests/python/unittest/common.py with_seed).")
+
+# documented-as-XLA-owned (reference knobs with no TPU-side effect)
+register_knob(
+    "xla.memory_pool", "MXNET_GPU_MEM_POOL_TYPE", str, "xla",
+    "reference memory-pool knobs (env_var.md:88-105) are owned by the XLA "
+    "allocator on TPU; value is informational.")
+register_knob(
+    "xla.autotune", "MXNET_CUDNN_AUTOTUNE_DEFAULT", int, 0,
+    "cuDNN autotune (env_var.md:234) maps to XLA's internal autotuning; "
+    "value is informational.")
+
+
+def _autostart():
+    if get("profiler.autostart"):
+        from . import profiler
+        profiler.set_config(filename=get("profiler.filename"))
+        profiler.start()
+
+
+_autostart()

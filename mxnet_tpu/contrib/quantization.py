@@ -116,44 +116,34 @@ def _input_key(x):
 
 
 def _quantize_symbol(sym, thresholds, excluded_names):
-    """Rebuild the DAG replacing quantizable ops with their REAL int8
-    versions (the quantize_graph_pass.cc analog): FullyConnected /
-    Convolution become _contrib_quantized_* ops that quantize both operands
-    to int8, contract with s32 accumulation on the MXU, and rescale to f32
-    (ops/contrib.py).  A node is only swapped when calibration produced
-    thresholds for BOTH its data and weight inputs."""
-    from ..symbol.symbol import Symbol, Group
+    """Graph pass replacing quantizable ops with their REAL int8 versions
+    (the quantize_graph_pass.cc analog): FullyConnected / Convolution
+    become _contrib_quantized_* ops that quantize both operands to int8,
+    contract with s32 accumulation on the MXU, and rescale to f32
+    (ops/contrib.py).  Runs through the pluggable pass machinery
+    (symbol/subgraph.py)."""
+    from ..symbol.symbol import Symbol
+    from ..symbol.subgraph import rewrite_nodes
 
-    memo = {}
-
-    def rebuild(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        if node.kind == "var":
-            out = node
-        else:
-            new_inputs = [rebuild(x) if isinstance(x, Symbol) else x
-                          for x in node.inputs]
-            op_name = node.op
-            attrs = dict(node.attrs)
-            if node.op in _QUANTIZED_OP and node.name not in excluded_names:
-                keys = [_input_key(x) for x in new_inputs[:2]
-                        if isinstance(x, Symbol)]
-                # weight threshold always exists (from arg_params); a missing
-                # DATA threshold (calib_mode='none') becomes amax_data=0 =
-                # runtime range inside the quantized op
-                if len(keys) == 2 and thresholds.get(keys[1]):
-                    op_name = _QUANTIZED_OP[node.op]
-                    attrs["amax_data"] = float(thresholds.get(keys[0], 0.0))
-                    attrs["amax_weight"] = float(thresholds[keys[1]])
-            out = Symbol(node.kind, node.name, op_name, attrs,
-                         new_inputs, node.index)
-            out._attr_map = dict(node._attr_map)
-        memo[id(node)] = out
+    def swap(node, new_inputs):
+        if node.op not in _QUANTIZED_OP or node.name in excluded_names:
+            return None
+        keys = [_input_key(x) for x in new_inputs[:2]
+                if isinstance(x, Symbol)]
+        # weight threshold always exists (from arg_params); a missing
+        # DATA threshold (calib_mode='none') becomes amax_data=0 =
+        # runtime range inside the quantized op
+        if len(keys) != 2 or not thresholds.get(keys[1]):
+            return None
+        attrs = dict(node.attrs)
+        attrs["amax_data"] = float(thresholds.get(keys[0], 0.0))
+        attrs["amax_weight"] = float(thresholds[keys[1]])
+        out = Symbol(node.kind, node.name, _QUANTIZED_OP[node.op], attrs,
+                     new_inputs, node.index)
+        out._attr_map = dict(node._attr_map)
         return out
 
-    heads = [rebuild(h) for h in sym._heads()]
-    return heads[0] if len(heads) == 1 else Group(heads)
+    return rewrite_nodes(sym, swap)
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
@@ -228,3 +218,13 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     qsym = _quantize_symbol(sym, thresholds, set(excluded_sym_names))
     return qsym, arg_params, aux_params
+
+
+# register on the pluggable pass registry (symbol/subgraph.py) so scripts can
+# run `mx.sym.subgraph.apply_pass(sym, "QuantizeGraph", thresholds=...)`
+from ..symbol.subgraph import register_pass as _register_pass  # noqa: E402
+
+
+@_register_pass("QuantizeGraph")
+def _quantize_graph_pass(sym, thresholds=None, excluded_names=(), **_):
+    return _quantize_symbol(sym, thresholds or {}, set(excluded_names))

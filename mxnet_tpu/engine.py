@@ -23,8 +23,10 @@ import os
 __all__ = ["bulk", "set_bulk_size", "engine_type", "set_engine_type",
            "naive_engine_enabled"]
 
-_BULK_SIZE = [int(os.environ.get("MXNET_ENGINE_BULK_SIZE", 15))]
-_ENGINE_TYPE = [os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")]
+from . import config as _config
+
+_BULK_SIZE = [_config.get("engine.bulk_size")]
+_ENGINE_TYPE = [_config.get("engine.type")]
 
 
 def set_bulk_size(size):

@@ -1,0 +1,116 @@
+"""``mx.library`` — dynamic operator libraries.
+
+Reference: ``mx.library.load("libmyop.so")`` → dlopen + initialize handshake
+(src/c_api/c_api.cc:96-104 MXLoadLib, include/mxnet/lib_api.h,
+python/mxnet/library.py:25-49).
+
+TPU-native re-design: two plugin flavors, both landing ops in the ONE
+registry every namespace (nd/sym/gluon) resolves from:
+
+* **Python plugins** (``.py``): the module is imported and its
+  ``register_ops()`` hook runs with full access to ``mxnet_tpu.ops.register``
+  — pure-jax ops plug straight into the jit/grad/sharding machinery.
+* **Native plugins** (``.so``): a small C ABI (below) is loaded with
+  ctypes; each exported kernel becomes a registry op executed through
+  ``jax.pure_callback`` (the same bridge as CustomOp, src/operator/custom/),
+  so native host kernels compose with jit-compiled graphs.
+
+Native ABI (versioned, f32 same-shape kernels)::
+
+    int         mxtpu_lib_version(void);          // must return 1
+    int         mxtpu_op_count(void);
+    const char* mxtpu_op_name(int i);
+    int         mxtpu_op_exec(int i, const float* in, float* out,
+                              long long n);       // 0 on success
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+__all__ = ["load", "loaded_libraries"]
+
+ABI_VERSION = 1
+_LOADED = {}
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an operator library; returns the list of newly registered op
+    names (reference: python/mxnet/library.py load)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise OSError("library %r not found" % path)
+    if path.endswith(".py"):
+        names = _load_python(path)
+    else:
+        names = _load_native(path)
+    _LOADED[path] = names
+    if verbose:
+        print("loaded library %s: ops %s" % (path, names))
+    return names
+
+
+def _load_python(path):
+    import importlib.util
+    from .ops.registry import _REGISTRY
+
+    before = set(_REGISTRY)
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_plugin_%s" % os.path.basename(path)[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if hasattr(mod, "register_ops"):
+        mod.register_ops()
+    return sorted(set(_REGISTRY) - before)
+
+
+def _load_native(path):
+    import numpy as _np
+    import jax
+    from .ops.registry import register
+
+    lib = ctypes.CDLL(path)
+    lib.mxtpu_lib_version.restype = ctypes.c_int
+    version = lib.mxtpu_lib_version()
+    if version != ABI_VERSION:
+        raise RuntimeError(
+            "library %s was built for ABI v%d; this runtime speaks v%d "
+            "(the MXLoadLib initialize(MXNET_VERSION) handshake)"
+            % (path, version, ABI_VERSION))
+    lib.mxtpu_op_count.restype = ctypes.c_int
+    lib.mxtpu_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_exec.restype = ctypes.c_int
+    lib.mxtpu_op_exec.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+
+    names = []
+    for i in range(lib.mxtpu_op_count()):
+        name = lib.mxtpu_op_name(i).decode()
+
+        def host_kernel(x, _i=i, _name=name):
+            x = _np.ascontiguousarray(_np.asarray(x), _np.float32)
+            out = _np.empty_like(x)
+            rc = lib.mxtpu_op_exec(
+                _i, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                x.size)
+            if rc != 0:
+                raise RuntimeError("native op %s failed with rc=%d"
+                                   % (_name, rc))
+            return out
+
+        def op_fn(data, _k=host_kernel, **_):
+            import jax.numpy as jnp
+            x = jnp.asarray(data).astype(jnp.float32)
+            return jax.pure_callback(
+                _k, jax.ShapeDtypeStruct(x.shape, jnp.float32), x)
+
+        register(name, differentiable=False)(op_fn)
+        names.append(name)
+    return names

@@ -26,6 +26,20 @@ __all__ = ["Module"]
 
 
 class Module(BaseModule):
+    """Symbolic Module (reference: python/mxnet/module/module.py:40).
+
+    PERFORMANCE NOTE — read before benchmarking with Module.fit: this path
+    keeps the reference's per-batch structure (forward, backward, then a
+    per-parameter optimizer update outside jit), which costs one host
+    round-trip per stage per batch.  It is numerically equivalent to
+    ``mx.parallel.SPMDTrainer`` (tested:
+    tests/test_parallel.py::test_module_vs_spmd_trainer_equivalence) but an
+    order of magnitude slower on TPU: SPMDTrainer fuses
+    forward+backward+allreduce+update into ONE jitted step and is the
+    intended hot path for every BASELINE.json config.  Use Module for
+    script parity and debugging; train with SPMDTrainer.
+    """
+
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,

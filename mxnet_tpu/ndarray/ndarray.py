@@ -150,6 +150,71 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    # ------------------------------------------------- numpy dispatch protocol
+    # Reference: python/mxnet/numpy_dispatch_protocol.py — official NumPy
+    # function/ufunc dispatch so `numpy.sum(mx_arr)` runs the framework's
+    # (taped, jit-able) implementation and returns framework arrays.
+    # Functions with no mx.np twin (np.linalg.*, np.fft.*, ufunc methods,
+    # out=) fall back to HOST numpy on coerced arrays — the exact behavior
+    # __array__ gave before the protocol existed, so nothing regresses.
+
+    @staticmethod
+    def _coerce_host(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if isinstance(x, (list, tuple)):
+            return type(x)(NDArray._coerce_host(v) for v in x)
+        if isinstance(x, dict):
+            return {k: NDArray._coerce_host(v) for k, v in x.items()}
+        return x
+
+    def __array_function__(self, func, types, args, kwargs):
+        import jax.numpy as _jnp
+        from .. import numpy as _mnp
+        name = getattr(func, "__name__", None)
+        impl = getattr(_mnp, name, None) if name else None
+        # raw jnp passthroughs (result_type, dtype queries...) don't accept
+        # NDArray — they go to the host fallback, not protocol dispatch
+        if callable(impl) and not isinstance(impl, type) and \
+                impl is not getattr(_jnp, name, None):
+            try:
+                return impl(*args, **kwargs)
+            except (TypeError, AttributeError, NotImplementedError):
+                pass
+        # host fallback: no NDArray remains, so this cannot re-dispatch
+        return func(*NDArray._coerce_host(tuple(args)),
+                    **NDArray._coerce_host(kwargs))
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        from .. import numpy as _mnp
+        if method == "__call__" and kwargs.get("out") is None:
+            impl = getattr(_mnp, ufunc.__name__, None)
+            if callable(impl) and not isinstance(impl, type):
+                try:
+                    return impl(*inputs, **kwargs)
+                except (TypeError, AttributeError, NotImplementedError):
+                    pass
+        # host fallback (reduce/accumulate/outer, out=, unknown ufuncs)
+        out = kwargs.get("out")
+        nd_outs = tuple(o for o in (out or ()) if isinstance(o, NDArray))
+        if out is not None:
+            # asnumpy() views the device buffer read-only; out= needs a
+            # writable host scratch that we copy back below
+            kwargs["out"] = tuple(
+                o.asnumpy().copy() if isinstance(o, NDArray)
+                else o for o in out)
+        host = getattr(ufunc, method)(
+            *NDArray._coerce_host(tuple(inputs)), **kwargs)
+        if nd_outs:
+            # write results back into the NDArray destinations
+            import jax.numpy as _jnp
+            host_outs = kwargs["out"]
+            for o, h in zip(out, host_outs):
+                if isinstance(o, NDArray):
+                    o._set_data(_jnp.asarray(h))
+            return out[0] if len(out) == 1 else out
+        return host
+
     # --------------------------------------------------------- sync / engine
     def wait_to_read(self):
         """Block until async compute producing this array finishes

@@ -101,6 +101,20 @@ def get_include():
     return _onp.get_include()
 
 
+def fix(x, out=None):
+    """Round toward zero (jnp.fix is deprecated; trunc is its exact
+    replacement).  Honors numpy's out= contract."""
+    x = x._data if isinstance(x, NDArray) else x
+    result = jnp.trunc(jnp.asarray(x))
+    if out is not None:
+        if isinstance(out, NDArray):
+            out._set_data(result.astype(out._data.dtype))
+            return out
+        raise TypeError("fix: out= must be an mx NDArray, got %r"
+                        % type(out))
+    return _wrap(result)
+
+
 # Ops whose outputs are not differentiable — generic delegation must not
 # tape a vjp through them (integer/bool outputs break jax.vjp).
 _NONDIFF = {"argmax", "argmin", "argsort", "argwhere", "nonzero", "sign",

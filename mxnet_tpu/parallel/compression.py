@@ -1,0 +1,58 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Reference: src/kvstore/gradient_compression.cc:60 SetTwoBitCompression —
+each gradient element is quantized to {-threshold, 0, +threshold} (2 bits),
+the quantization error accumulates into a per-key residual added back next
+step, and the wire carries 16 elements per 32-bit word.
+
+TPU-native: the codes pack 4 elements per uint8 with jnp bit ops, so a DCN
+(host-network) push moves 1/16 of the f32 bytes; ICI allreduce stays
+uncompressed (compiler-scheduled psum at full bandwidth is faster than any
+recompression, which is why the kvstore facade documents compression as a
+DCN-path feature).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["two_bit_compress", "two_bit_decompress", "pack_2bit",
+           "unpack_2bit"]
+
+
+def two_bit_compress(grad, residual, threshold):
+    """(grad, residual) -> (codes int8 in {-1, 0, +1}, new_residual).
+
+    codes * threshold is the decompressed gradient; the difference feeds
+    back into the residual (error feedback keeps the update unbiased over
+    time, reference gradient_compression-inl.h quantize_2bit kernel).
+    """
+    g = jnp.asarray(grad) + jnp.asarray(residual)
+    codes = jnp.where(g >= threshold, 1,
+                      jnp.where(g <= -threshold, -1, 0)).astype(jnp.int8)
+    new_residual = g - codes.astype(g.dtype) * threshold
+    return codes, new_residual
+
+
+def two_bit_decompress(codes, threshold, dtype=jnp.float32):
+    return codes.astype(dtype) * threshold
+
+
+def pack_2bit(codes):
+    """int8 {-1,0,1} [N] -> uint8 [ceil(N/4)] wire format (4 elems/byte)."""
+    flat = codes.ravel()
+    n = flat.shape[0]
+    padded = jnp.zeros(((n + 3) // 4) * 4, jnp.uint8)
+    # map {-1,0,1} -> {2,0,1} (2 bits each)
+    u = jnp.where(flat < 0, 2, flat).astype(jnp.uint8)
+    padded = padded.at[:n].set(u)
+    q = padded.reshape(-1, 4)
+    return (q[:, 0] | (q[:, 1] << 2) | (q[:, 2] << 4) |
+            (q[:, 3] << 6)).astype(jnp.uint8)
+
+
+def unpack_2bit(packed, n):
+    """uint8 wire bytes -> int8 codes [n]."""
+    p = jnp.asarray(packed, jnp.uint8)
+    parts = jnp.stack([p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
+                      axis=1).reshape(-1)[:n]
+    return jnp.where(parts == 2, -1, parts).astype(jnp.int8)

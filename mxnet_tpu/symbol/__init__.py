@@ -10,9 +10,10 @@ from __future__ import annotations
 
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
                      Executor, zeros, ones, _make_op_node)
+from . import subgraph  # noqa: F401  (pass registry / subgraph framework)
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "Executor", "zeros", "ones"]
+           "Executor", "zeros", "ones", "subgraph"]
 
 from ..ops import registry as _registry
 

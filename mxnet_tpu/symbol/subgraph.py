@@ -1,0 +1,185 @@
+"""Pluggable graph-pass / subgraph-partition framework.
+
+Reference: the NNVM pass registry (``nnvm::ApplyPass``) and the subgraph
+framework (src/operator/subgraph/subgraph_property.h:86 SubgraphSelector,
+:252-318 SubgraphProperty::CreateSubgraphNode; build_subgraph.cc invoked at
+bind, graph_executor.cc:2015) that powers MKLDNN conv fusion, quantized-op
+fusion and the TensorRT bridge.
+
+TPU-native re-design: XLA already owns kernel fusion, so the extension point
+here is at the SYMBOL DAG level — where the reference rewrites NNVM graphs,
+we rewrite the immutable Symbol DAG before it is traced/jitted:
+
+* ``register_pass(name)(fn)`` / ``apply_pass(sym, name, **kw)`` — the
+  ApplyPass analog; a pass is ``fn(sym, **kw) -> sym``.
+* ``SubgraphProperty`` — declarative node-set selection + replacement: a
+  selector marks matching nodes, connected matches are grouped, and
+  ``create_subgraph_node`` maps each group to a replacement op node.  The
+  built-in quantization rewrite (contrib/quantization.py) and the AMP
+  recolor (amp.py) run through this machinery.
+
+A rewritten Symbol executes through the ordinary jit path, so a custom pass
+composes with sharding/pjit exactly like built-in graphs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__ = ["register_pass", "apply_pass", "list_passes", "SubgraphProperty",
+           "build_subgraph", "rewrite_nodes"]
+
+_PASSES: Dict[str, Callable] = {}
+
+
+def register_pass(name):
+    """Decorator registering a graph pass ``fn(sym, **kw) -> sym``."""
+
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+_BUILTINS_LOADED = False
+
+
+def _load_builtin_passes():
+    """Import the modules that register the built-in passes (lazy to avoid
+    an import cycle: amp/quantization themselves import mx.symbol)."""
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    from .. import amp  # noqa: F401  registers AMPLowPrecision
+    from ..contrib import quantization  # noqa: F401  registers QuantizeGraph
+
+
+def apply_pass(sym, name, **kwargs):
+    """Run a registered pass on a Symbol (nnvm::ApplyPass analog)."""
+    _load_builtin_passes()
+    if name not in _PASSES:
+        raise ValueError("no graph pass named %r (have: %s)"
+                         % (name, sorted(_PASSES)))
+    return _PASSES[name](sym, **kwargs)
+
+
+def list_passes():
+    _load_builtin_passes()
+    return sorted(_PASSES)
+
+
+def rewrite_nodes(sym, fn):
+    """Bottom-up DAG rebuild: ``fn(node, new_inputs) -> Symbol | None``.
+
+    ``fn`` returns a replacement node (with the given rebuilt inputs) or
+    None to keep the node with its inputs swapped.  Shared subexpressions
+    stay shared (memoized by node identity) — the common frame under every
+    pass here and in amp/quantization.
+    """
+    from .symbol import Symbol, Group
+
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.kind == "var":
+            out = node
+        else:
+            new_inputs = [rebuild(x) if isinstance(x, Symbol) else x
+                          for x in node.inputs]
+            out = fn(node, new_inputs)
+            if out is None:
+                out = Symbol(node.kind, node.name, node.op,
+                             dict(node.attrs), new_inputs, node.index)
+                out._attr_map = dict(node._attr_map)
+        memo[id(node)] = out
+        return out
+
+    heads = [rebuild(h) for h in sym._heads()]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+class SubgraphProperty:
+    """Declarative select-and-replace (reference subgraph_property.h).
+
+    Subclasses override:
+      select(node) -> bool            does this op node start/join a match
+      create_subgraph_node(nodes, inputs) -> Symbol
+                                      replacement for one connected match
+    ``build_subgraph`` walks the DAG, groups CONNECTED selected nodes
+    (a node and its selected producer belong to one group, mirroring
+    SubgraphSelector::SelectInput/SelectOutput), and substitutes each
+    group's sink with the property's replacement node.
+    """
+
+    def select(self, node):
+        raise NotImplementedError
+
+    def create_subgraph_node(self, nodes, inputs):
+        raise NotImplementedError
+
+
+def build_subgraph(sym, prop):
+    """Apply a SubgraphProperty over a Symbol (build_subgraph.cc analog).
+
+    Groups are formed on the ORIGINAL graph along single-consumer def-use
+    chains of selected nodes: a selected producer joins its selected
+    consumer's group only when that consumer is its sole user, so a node
+    whose output escapes the group is never absorbed (the reference's
+    output-escape rule in SubgraphSelector).  Each group — nodes in
+    producers-first order — is replaced at its sink by
+    ``prop.create_subgraph_node(group_nodes, external_inputs)``, where
+    external_inputs are the REBUILT inputs feeding the group from outside,
+    in group-order of first use.
+    """
+    from .symbol import Symbol, Group, _topo
+
+    # consumer counts on the original DAG (op-node uses only)
+    consumers = {}
+    for n in _topo(sym):
+        if n.kind == "op":
+            for x in n.inputs:
+                if isinstance(x, Symbol):
+                    consumers[id(x)] = consumers.get(id(x), 0) + 1
+
+    def absorb(node):
+        """The group whose sink is `node`, producers first."""
+        out = []
+        for x in node.inputs:
+            if isinstance(x, Symbol) and x.kind == "op" and \
+                    prop.select(x) and consumers.get(id(x), 0) == 1:
+                out.extend(absorb(x))
+        out.append(node)
+        return out
+
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.kind == "var":
+            out = node
+        elif prop.select(node):
+            group = absorb(node)
+            inside = {id(g) for g in group}
+            externals = []
+            for g in group:
+                for x in g.inputs:
+                    if isinstance(x, Symbol) and id(x) in inside:
+                        continue
+                    externals.append(rebuild(x) if isinstance(x, Symbol)
+                                     else x)
+            out = prop.create_subgraph_node(group, externals)
+        else:
+            new_inputs = [rebuild(x) if isinstance(x, Symbol) else x
+                          for x in node.inputs]
+            out = Symbol(node.kind, node.name, node.op, dict(node.attrs),
+                         new_inputs, node.index)
+            out._attr_map = dict(node._attr_map)
+        memo[id(node)] = out
+        return out
+
+    heads = [rebuild(h) for h in sym._heads()]
+    return heads[0] if len(heads) == 1 else Group(heads)

@@ -1,0 +1,96 @@
+"""Dynamic op library loading (reference: MXLoadLib c_api.cc:96-104,
+python/mxnet/library.py)."""
+import os
+import subprocess
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_python_plugin(tmp_path):
+    plugin = tmp_path / "myops.py"
+    plugin.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import register
+
+        def register_ops():
+            @register("plugin_double")
+            def _double(data, **_):
+                return jnp.asarray(data) * 2.0
+    """))
+    names = mx.library.load(str(plugin), verbose=False)
+    assert "plugin_double" in names
+    out = mx.nd.plugin_double(mx.nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 4.0])
+
+
+CSRC = r"""
+extern "C" {
+int mxtpu_lib_version() { return 1; }
+int mxtpu_op_count() { return 2; }
+const char* mxtpu_op_name(int i) {
+    return i == 0 ? "native_negate" : "native_offset3";
+}
+int mxtpu_op_exec(int i, const float* in, float* out, long long n) {
+    for (long long k = 0; k < n; ++k)
+        out[k] = (i == 0) ? -in[k] : in[k] + 3.0f;
+    return 0;
+}
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def native_lib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("libs")
+    src = d / "plugin.cc"
+    so = d / "libplugin.so"
+    src.write_text(CSRC)
+    try:
+        subprocess.run(["g++", "-shared", "-fPIC", "-O2", str(src), "-o",
+                        str(so)], check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("g++ unavailable")
+    return str(so)
+
+
+def test_native_plugin(native_lib):
+    names = mx.library.load(native_lib, verbose=False)
+    assert names == ["native_negate", "native_offset3"]
+    x = mx.nd.array(np.array([1.5, -2.0], np.float32))
+    np.testing.assert_allclose(mx.nd.native_negate(x).asnumpy(),
+                               [-1.5, 2.0])
+    np.testing.assert_allclose(mx.nd.native_offset3(x).asnumpy(),
+                               [4.5, 1.0])
+    assert native_lib in mx.library.loaded_libraries()
+
+
+def test_native_plugin_composes_with_jit(native_lib):
+    import jax
+    import jax.numpy as jnp
+    mx.library.load(native_lib, verbose=False)
+    from mxnet_tpu.ops.registry import _REGISTRY
+    fn = _REGISTRY["native_negate"].fn
+
+    @jax.jit
+    def f(x):
+        return fn(jnp.tanh(x)) * 2.0
+
+    out = np.asarray(f(jnp.asarray([0.5, -0.5])))
+    np.testing.assert_allclose(out, -2 * np.tanh([0.5, -0.5]), rtol=1e-6)
+
+
+def test_bad_abi_version(tmp_path):
+    src = tmp_path / "bad.cc"
+    so = tmp_path / "libbad.so"
+    src.write_text('extern "C" int mxtpu_lib_version() { return 99; }')
+    try:
+        subprocess.run(["g++", "-shared", "-fPIC", str(src), "-o", str(so)],
+                       check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("g++ unavailable")
+    with pytest.raises(RuntimeError, match="ABI"):
+        mx.library.load(str(so), verbose=False)

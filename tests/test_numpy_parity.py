@@ -1,0 +1,142 @@
+"""mx.np surface parity + NumPy dispatch protocol (reference:
+python/mxnet/numpy/multiarray.py 262 defs,
+python/mxnet/numpy_dispatch_protocol.py,
+tests/python/unittest/test_numpy_interoperability.py).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+# the reference's dispatched-function inventory (numpy_dispatch_protocol.py
+# _NUMPY_ARRAY_FUNCTION_LIST, trimmed to what NumPy itself still ships):
+# every name must resolve on mx.np.
+PARITY_SURFACE = """
+abs absolute add all allclose amax amin any append arange arccos arccosh
+arcsin arcsinh arctan arctan2 arctanh argmax argmin argsort around array
+array_equal atleast_1d atleast_2d atleast_3d average bincount broadcast_to
+cbrt ceil clip column_stack concatenate copysign cos cosh count_nonzero
+cross cumsum deg2rad degrees diag diagonal diff divide dot dsplit dstack
+einsum equal exp expand_dims expm1 eye fix flip floor fmax fmin full
+greater greater_equal hsplit hstack hypot inner isfinite isinf isnan
+kron lcm less less_equal linspace log log10 log1p log2 logaddexp
+logical_and logical_not logical_or logical_xor matmul maximum mean median
+meshgrid minimum mod moveaxis multiply negative nonzero not_equal ones
+ones_like outer percentile power prod ptp quantile rad2deg radians ravel
+reciprocal remainder repeat reshape roll rot90 round sign sin sinh sort
+split sqrt square squeeze stack std subtract sum swapaxes take tan tanh
+tensordot tile trace transpose tril triu true_divide trunc unique var
+vdot vsplit vstack where zeros zeros_like
+""".split()
+
+
+def test_parity_surface_resolves():
+    missing = [n for n in PARITY_SURFACE if not hasattr(mnp, n)]
+    assert not missing, "mx.np lacks reference-dispatched names: %s" % missing
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "matmul", "where", "clip",
+                                  "einsum", "tensordot", "median", "std",
+                                  "percentile", "cumsum", "diff", "outer",
+                                  "tril", "roll"])
+def test_value_parity_vs_numpy(name):
+    rng = onp.random.RandomState(0)
+    a = rng.randn(4, 4).astype(onp.float32)
+    b = rng.randn(4, 4).astype(onp.float32)
+    cases = {
+        "sum": (lambda f: f(mnp.array(a), axis=1), lambda: onp.sum(a, 1)),
+        "mean": (lambda f: f(mnp.array(a), axis=0), lambda: onp.mean(a, 0)),
+        "matmul": (lambda f: f(mnp.array(a), mnp.array(b)),
+                   lambda: a @ b),
+        "where": (lambda f: f(mnp.array(a) > 0, mnp.array(a),
+                              mnp.array(b)),
+                  lambda: onp.where(a > 0, a, b)),
+        "clip": (lambda f: f(mnp.array(a), -0.5, 0.5),
+                 lambda: onp.clip(a, -0.5, 0.5)),
+        "einsum": (lambda f: f("ij,jk->ik", mnp.array(a), mnp.array(b)),
+                   lambda: onp.einsum("ij,jk->ik", a, b)),
+        "tensordot": (lambda f: f(mnp.array(a), mnp.array(b)),
+                      lambda: onp.tensordot(a, b)),
+        "median": (lambda f: f(mnp.array(a)), lambda: onp.median(a)),
+        "std": (lambda f: f(mnp.array(a)), lambda: onp.std(a)),
+        "percentile": (lambda f: f(mnp.array(a), 75),
+                       lambda: onp.percentile(a, 75)),
+        "cumsum": (lambda f: f(mnp.array(a), axis=1),
+                   lambda: onp.cumsum(a, 1)),
+        "diff": (lambda f: f(mnp.array(a), axis=0),
+                 lambda: onp.diff(a, axis=0)),
+        "outer": (lambda f: f(mnp.array(a[0]), mnp.array(b[0])),
+                  lambda: onp.outer(a[0], b[0])),
+        "tril": (lambda f: f(mnp.array(a)), lambda: onp.tril(a)),
+        "roll": (lambda f: f(mnp.array(a), 1, axis=0),
+                 lambda: onp.roll(a, 1, 0)),
+    }
+    run, ref = cases[name]
+    out = run(getattr(mnp, name))
+    host = out.asnumpy() if isinstance(out, NDArray) else onp.asarray(out)
+    onp.testing.assert_allclose(host, ref(), rtol=2e-5, atol=1e-5)
+
+
+def test_array_function_protocol_dispatch():
+    """numpy.<fn>(mx_array) routes through mx.np and RETURNS mx arrays —
+    the reference dispatch protocol's contract."""
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    out = onp.sum(a, axis=1)
+    assert isinstance(out, NDArray), type(out)
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 7.0])
+    out = onp.concatenate([a, a], axis=0)
+    assert isinstance(out, NDArray)
+    assert out.shape == (4, 2)
+
+
+def test_array_ufunc_protocol_dispatch():
+    a = mnp.array([1.0, 4.0])
+    out = onp.sqrt(a)
+    assert isinstance(out, NDArray), type(out)
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+    out = onp.add(a, a)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 8.0])
+
+
+def test_dispatched_ops_are_taped():
+    """The protocol path must stay differentiable (goes through apply_op)."""
+    x = mnp.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = onp.multiply(x, x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_np_namespace_grad_through_getattr():
+    x = mnp.array([0.5, 1.5])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mnp.tanh(x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                1 - onp.tanh([0.5, 1.5]) ** 2, rtol=1e-6)
+
+
+def test_host_fallback_for_undispatched_functions():
+    """np.linalg.*, ufunc methods and out= have no mx.np twin: they must
+    fall back to host numpy (the pre-protocol behavior), not raise."""
+    a = mnp.array([[3.0, 0.0], [0.0, 4.0]])
+    n = onp.linalg.norm(a)          # np.linalg has no top-level jnp twin
+    assert float(n) == pytest.approx(5.0)
+    r = onp.add.reduce(mnp.array([1.0, 2.0, 3.0]))   # ufunc method
+    assert float(r) == pytest.approx(6.0)
+    dest = mnp.array([0.0, 0.0])
+    out = onp.add(mnp.array([1.0, 2.0]), mnp.array([3.0, 4.0]), out=dest)
+    onp.testing.assert_allclose(dest.asnumpy(), [4.0, 6.0])
+    assert out is dest
+
+
+def test_fix_out_contract():
+    dest = mnp.array([0.0, 0.0])
+    got = mnp.fix(mnp.array([1.7, -1.7]), out=dest)
+    onp.testing.assert_allclose(dest.asnumpy(), [1.0, -1.0])
+    assert got is dest

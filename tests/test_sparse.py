@@ -136,3 +136,43 @@ def test_dense_grad_embedding_unchanged():
     w1 = emb.weight.data().asnumpy()
     # wd shrinks even untouched rows on the dense path
     assert np.abs(w1[[0, 2, 3, 4, 5]] - w0[[0, 2, 3, 4, 5]]).max() > 1e-7
+
+
+def test_two_bit_compression_roundtrip_and_packing():
+    """2-bit codes + error feedback (reference gradient_compression.cc)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.compression import (
+        two_bit_compress, two_bit_decompress, pack_2bit, unpack_2bit)
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(1001).astype(np.float32))
+    res = jnp.zeros_like(g)
+    codes, res = two_bit_compress(g, res, 0.5)
+    assert set(np.unique(np.asarray(codes))) <= {-1, 0, 1}
+    # error feedback: decompressed + residual == original exactly
+    np.testing.assert_allclose(
+        np.asarray(two_bit_decompress(codes, 0.5) + res), np.asarray(g),
+        rtol=1e-6, atol=1e-6)
+    # wire packing: 4 codes/byte, exact roundtrip
+    wire = pack_2bit(codes)
+    assert wire.shape[0] == (1001 + 3) // 4
+    np.testing.assert_array_equal(np.asarray(unpack_2bit(wire, 1001)),
+                                  np.asarray(codes))
+
+
+def test_two_bit_error_feedback_converges():
+    """Residual feedback makes the compressed sum track the true sum."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.compression import (two_bit_compress,
+                                                two_bit_decompress)
+    rng = np.random.RandomState(1)
+    g = jnp.asarray(rng.uniform(-0.2, 0.2, 64).astype(np.float32))
+    res = jnp.zeros_like(g)
+    acc = jnp.zeros_like(g)
+    steps = 50
+    for _ in range(steps):
+        codes, res = two_bit_compress(g, res, 0.5)
+        acc = acc + two_bit_decompress(codes, 0.5)
+    # accumulated compressed updates approximate steps * g within one
+    # threshold quantum per element
+    np.testing.assert_allclose(np.asarray(acc / steps), np.asarray(g),
+                               atol=0.5 / steps + 1e-3)

@@ -1,0 +1,167 @@
+"""Multi-chip scaling harness — ready to run the day real chips show up.
+
+Reference analogs: example/image-classification/train_imagenet.py
+--benchmark 1 run across GPU counts (README.md:290-320, the 90.1%% 256-GPU
+scaling table) and tools/bandwidth/ (kvstore allreduce bandwidth
+measurement).
+
+Two measurements over a dp mesh of 1..N devices:
+  * ResNet-50 synthetic-data training throughput per device count, with
+    scaling efficiency vs the 1-device number;
+  * gradient-allreduce (psum) bus bandwidth, the tools/bandwidth analog.
+
+On a CPU host, validate the harness with virtual devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python tools/scaling_bench.py --model dense --iters 3
+On TPU hardware it runs as-is on every visible chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _devices_sweep(max_devices):
+    import jax
+    n = len(jax.devices())
+    if max_devices:
+        n = min(n, max_devices)
+    sweep = []
+    d = 1
+    while d <= n:
+        sweep.append(d)
+        d *= 2
+    if sweep[-1] != n:
+        sweep.append(n)
+    return sweep
+
+
+def _build_net(model):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    if model == "resnet50":
+        from mxnet_tpu.gluon.model_zoo import vision
+        net = vision.get_model("resnet50_v1", classes=1000)
+        shape = (3, 224, 224)
+    else:  # small dense model for CPU harness validation
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(256, activation="relu"),
+                    gluon.nn.Dense(10))
+        shape = (64,)
+    net.initialize(mx.init.Xavier())
+    return net, shape
+
+
+def bench_training_scaling(model="resnet50", per_device_batch=32, iters=20,
+                           max_devices=None):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import SPMDTrainer
+    from jax.sharding import Mesh
+
+    results = []
+    net, shape = _build_net(model)
+    rng = np.random.RandomState(0)
+    base = None
+    for nd_ in _devices_sweep(max_devices):
+        batch = per_device_batch * nd_
+        data = rng.uniform(size=(batch,) + shape).astype(np.float32)
+        label = rng.randint(0, 10, (batch,)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:nd_]), ("dp",))
+        tr = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9},
+                         mesh=mesh)
+        tr._materialize(data)
+        loss = tr.step(data, label)
+        np.asarray(loss)          # compile + settle
+        ddev = jax.device_put(jnp.asarray(data), tr._batch_sharding)
+        ldev = jax.device_put(jnp.asarray(label), tr._batch_sharding)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = tr.step(ddev, ldev)
+        np.asarray(loss)
+        dt = time.perf_counter() - t0
+        img_s = batch * iters / dt
+        if base is None:
+            base = img_s
+        results.append({
+            "devices": nd_,
+            "global_batch": batch,
+            "img_s": round(img_s, 2),
+            "scaling_efficiency": round(img_s / (base * nd_), 4),
+        })
+        print("devices=%d batch=%d: %.1f samples/s (eff %.1f%%)"
+              % (nd_, batch, img_s,
+                 100 * results[-1]["scaling_efficiency"]), flush=True)
+    return results
+
+
+def bench_allreduce_bandwidth(sizes_mb=(1, 16, 64), max_devices=None):
+    """psum bus bandwidth over the dp mesh (tools/bandwidth analog)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = len(jax.devices()) if not max_devices else \
+        min(len(jax.devices()), max_devices)
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("dp",))
+    results = []
+    for mb in sizes_mb:
+        elems = int(mb * 1024 * 1024 / 4)
+        x = jnp.ones((n, elems), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+        @jax.jit
+        def allreduce(v):
+            return jax.shard_map(
+                lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
+                in_specs=P("dp"), out_specs=P("dp"))(v)
+
+        np.asarray(allreduce(x))[0, 0]
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = allreduce(x)
+        np.asarray(out)[0, 0]
+        dt = (time.perf_counter() - t0) / reps
+        # ring-allreduce moves 2*(n-1)/n of the payload per device
+        algo_bytes = mb * 1024 * 1024 * 2 * (n - 1) / max(n, 1)
+        results.append({"size_mb": mb, "devices": n,
+                        "time_ms": round(dt * 1e3, 3),
+                        "bus_gb_s": round(algo_bytes / dt / 1e9, 2)})
+        print("allreduce %dMB on %d devices: %.2fms (%.1f GB/s bus)"
+              % (mb, n, dt * 1e3, results[-1]["bus_gb_s"]), flush=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "dense"])
+    ap.add_argument("--per-device-batch", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--max-devices", type=int, default=None)
+    ap.add_argument("--skip-bandwidth", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    out = {"training": bench_training_scaling(
+        args.model, args.per_device_batch, args.iters, args.max_devices)}
+    if not args.skip_bandwidth:
+        out["allreduce"] = bench_allreduce_bandwidth(
+            max_devices=args.max_devices)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
