@@ -11,5 +11,9 @@ from .. import amp  # noqa: F401  (mx.contrib.amp parity alias)
 # control-flow ops at their reference location (python/mxnet/ndarray/
 # contrib.py foreach :216, while_loop :340, cond :480)
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from . import text  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import onnx  # noqa: F401  (gated: StableHLO is the TPU interchange)
 
-__all__ = ["quantization", "amp", "foreach", "while_loop", "cond"]
+__all__ = ["quantization", "amp", "foreach", "while_loop", "cond", "text",
+           "svrg_optimization", "onnx"]

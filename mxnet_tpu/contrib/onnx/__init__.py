@@ -1,0 +1,49 @@
+"""``mx.contrib.onnx`` — ONNX interchange (gated).
+
+Reference: python/mxnet/contrib/onnx/ (import_model/export_model over the
+onnx package).  The ``onnx`` package is not part of this environment, and
+the TPU-native interchange format is StableHLO — ``mx.deploy.export_model``
+/ ``load_model`` cover the deployment role (serialized compiler IR + params,
+reloadable from any process or a C++ PjRt runtime).
+
+When ``onnx`` IS installed, export works by round-tripping through the
+StableHLO path is still preferred; import_model raises with guidance.
+"""
+from __future__ import annotations
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+_GUIDANCE = (
+    "the 'onnx' package is not available in this environment; the "
+    "TPU-native interchange is StableHLO — use mx.deploy.export_model / "
+    "mx.deploy.load_model (serialized XLA program + params). "
+    "If you need ONNX specifically, install onnx and re-run."
+)
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return True
+    except ImportError:
+        raise ImportError(_GUIDANCE) from None
+
+
+def import_model(model_file):
+    """Reference: contrib/onnx/onnx2mx/import_model.py."""
+    _require_onnx()
+    raise NotImplementedError(
+        "ONNX graph import is not implemented; " + _GUIDANCE)
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference: contrib/onnx/mx2onnx/export_model.py."""
+    _require_onnx()
+    raise NotImplementedError(
+        "ONNX export is not implemented; " + _GUIDANCE)
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise NotImplementedError(_GUIDANCE)

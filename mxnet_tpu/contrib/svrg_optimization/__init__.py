@@ -1,0 +1,5 @@
+"""SVRG optimization (reference: python/mxnet/contrib/svrg_optimization/)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import SVRGOptimizer
+
+__all__ = ["SVRGModule", "SVRGOptimizer"]

@@ -1,0 +1,125 @@
+"""SVRGModule — Module with stochastic variance-reduced gradients.
+
+Reference: python/mxnet/contrib/svrg_optimization/svrg_module.py:30 —
+keeps a snapshot of the weights every ``update_freq`` epochs, computes the
+full-batch gradient mu at the snapshot (:292 update_full_grads), and
+corrects every mini-batch gradient with ``g_i(w) - g_i(w_snap) + mu``
+before the optimizer step.
+
+TPU-native: the snapshot forward/backward reuses the same fused executor as
+training (no special kernel path), and the correction is three fused
+elementwise ops on device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...module import Module
+from ...ndarray.ndarray import _wrap
+from .svrg_optimizer import SVRGOptimizer
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = update_freq
+        self._snapshot = None        # weights at last full-grad computation
+        self._mu = None              # full-batch gradient at the snapshot
+
+    # ------------------------------------------------------------ snapshot
+    def take_snapshot(self):
+        arg, _ = self.get_params()
+        self._snapshot = {k: _wrap(jnp.asarray(v._data))
+                          for k, v in arg.items()}
+
+    def update_full_grads(self, train_data):
+        """Full-batch gradient at the CURRENT weights, stored as mu
+        (reference svrg_module.py:292)."""
+        self.take_snapshot()
+        sums = {}
+        batches = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward(batch, is_train=True)
+            self.backward()
+            for name, g in self._grad_arrays().items():
+                sums[name] = g if name not in sums else sums[name] + g
+            batches += 1
+        train_data.reset()
+        self._mu = {k: v / max(batches, 1) for k, v in sums.items()}
+
+    def _grad_arrays(self):
+        return {name: jnp.asarray(arr._data)
+                for name, arr in self._exec_grads().items()}
+
+    def _exec_grads(self):
+        return {name: self._exec.grad_dict[name]
+                for name in self._param_names
+                if self._exec.grad_dict.get(name) is not None}
+
+    # ------------------------------------------------------------ training
+    def _svrg_corrected_update(self, batch):
+        """One corrected step: needs grad at current w AND at snapshot w."""
+        # gradient at current weights
+        self.forward(batch, is_train=True)
+        self.backward()
+        cur = {k: jnp.asarray(v) for k, v in self._grad_arrays().items()}
+        if self._mu is None:
+            self.update()
+            return
+        # gradient of the SAME batch at the snapshot weights
+        live = {k: _wrap(jnp.asarray(v._data))
+                for k, v in self.get_params()[0].items()}
+        self.set_params(self._snapshot, self.get_params()[1],
+                        allow_missing=True)
+        self.forward(batch, is_train=True)
+        self.backward()
+        snap = {k: jnp.asarray(v) for k, v in self._grad_arrays().items()}
+        self.set_params(live, self.get_params()[1], allow_missing=True)
+        # overwrite the executor grads with the corrected direction
+        for name, g in self._exec_grads().items():
+            g._data = SVRGOptimizer.correct(cur[name], snap[name],
+                                            self._mu.get(name, 0.0))
+        self.update()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd", optimizer_params=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_init=False, begin_epoch=0,
+            num_epoch=None, **kwargs):
+        """Module.fit with the SVRG schedule: refresh mu every
+        ``update_freq`` epochs (reference svrg_module.py:395)."""
+        from ... import initializer as init_mod
+        from ... import metric as metric_mod
+        if not self.binded:
+            first = next(iter(train_data))
+            train_data.reset()
+            self.bind([(n, tuple(d.shape)) for n, d in
+                       zip(self._data_names, first.data)],
+                      [(n, tuple(l.shape)) for n, l in
+                       zip(self._label_names, first.label)])
+        if not self.params_initialized or force_init:
+            self.init_params(initializer or init_mod.Uniform(0.01),
+                             arg_params, aux_params, allow_missing,
+                             force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params or
+                            {"learning_rate": 0.01})
+        em = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch or 1):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            em.reset()
+            train_data.reset()
+            for batch in train_data:
+                self._svrg_corrected_update(batch)
+                self.update_metric(em, batch.label)
+            if epoch_end_callback:
+                epoch_end_callback(epoch, self._symbol,
+                                   *self.get_params())
+        return em

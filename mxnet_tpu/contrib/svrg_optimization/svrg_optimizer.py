@@ -1,0 +1,50 @@
+"""SVRG variance-reduced gradient correction.
+
+Reference: python/mxnet/contrib/svrg_optimization/svrg_optimizer.py — wraps
+a base optimizer; the effective gradient for sample batch i is
+``g_i(w) - g_i(w_snapshot) + mu`` where mu is the full-batch gradient at the
+last snapshot (Johnson & Zhang 2013).
+
+TPU-native: the correction is pure elementwise math on jax arrays, so it
+fuses into the update; snapshot state lives beside the weights.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import optimizer as _opt
+
+__all__ = ["SVRGOptimizer"]
+
+
+@_opt.register
+class SVRGOptimizer(_opt.Optimizer):
+    """Dispatches corrected updates to an inner optimizer.
+
+    The module feeds three aligned tensors per parameter: the current batch
+    gradient, the SAME batch's gradient at the snapshot weights, and the
+    full-batch snapshot gradient mu; `correct()` forms the SVRG direction.
+    """
+
+    def __init__(self, default_optimizer="sgd", **kwargs):
+        # split kwargs: ours vs the wrapped optimizer's
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if k in ("learning_rate", "rescale_grad", "wd")})
+        if isinstance(default_optimizer, str):
+            inner_kwargs = dict(kwargs)
+            self.default_opt = _opt.create(default_optimizer, **inner_kwargs)
+        else:
+            self.default_opt = default_optimizer
+
+    @staticmethod
+    def correct(grad, snapshot_grad, mu):
+        return grad - snapshot_grad + mu
+
+    def create_state(self, index, weight):
+        return self.default_opt.create_state(index, weight)
+
+    def step(self, weight, grad, state, lr, wd, t):
+        return self.default_opt.step(weight, grad, state, lr, wd, t)
+
+    def update(self, index, weight, grad, state):
+        self.default_opt.update(index, weight, grad, state)

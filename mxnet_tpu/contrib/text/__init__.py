@@ -1,0 +1,9 @@
+"""``mx.contrib.text`` — vocabulary + pretrained token embeddings.
+
+Reference: python/mxnet/contrib/text/ (vocab.py Vocabulary, embedding.py
+registered GloVe/fastText loaders + CustomEmbedding, utils.py).
+"""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -1,0 +1,197 @@
+"""Pretrained token embeddings.
+
+Reference: python/mxnet/contrib/text/embedding.py — a registry of embedding
+formats (glove, fasttext) that download + parse pretrained vector files,
+plus CustomEmbedding for local files and CompositeEmbedding.
+
+TPU-native note: this environment has zero egress, so the download half of
+the reference (``pretrained_file_name`` fetch) raises with guidance; the
+FILE-parsing half — the part models actually consume — is fully functional:
+any GloVe/fastText-format text file loads into a (vocab_size, dim) device
+array aligned with a Vocabulary.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["register", "create", "list_embedding_names", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding", "GloVe", "FastText"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(embedding_name, **kwargs):
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("unknown embedding %r (have %s)"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def list_embedding_names():
+    return sorted(_REGISTRY)
+
+
+class TokenEmbedding:
+    """Token -> vector lookup parsed from a text file of
+    ``token v1 v2 ... vD`` lines (the GloVe/fastText interchange format)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 init_unknown_vec=None, vocabulary=None, **kwargs):
+        import jax.numpy as jnp
+        self._token_to_idx = {}
+        self._idx_to_token = []
+        self._vec_len = None
+        self._init_unknown = init_unknown_vec or (lambda d: _np.zeros(d))
+        vectors = []
+        if pretrained_file_path is not None:
+            if not os.path.exists(pretrained_file_path):
+                raise OSError(
+                    "pretrained file %r not found. This environment has no "
+                    "network egress: download GloVe/fastText files "
+                    "out-of-band and point pretrained_file_path at them "
+                    "(the reference's auto-download cannot run here)."
+                    % pretrained_file_path)
+            def _num(s):
+                try:
+                    float(s)
+                    return True
+                except ValueError:
+                    return False
+
+            first = True
+            with io.open(pretrained_file_path, encoding="utf-8") as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if first and len(parts) == 2 and all(map(_num, parts)):
+                        first = False
+                        continue  # fastText "count dim" header
+                    first = False
+                    if len(parts) < 2:
+                        continue  # malformed line
+                    token, vals = parts[0], parts[1:]
+                    if self._vec_len is None:
+                        self._vec_len = len(vals)
+                    elif len(vals) != self._vec_len:
+                        continue
+                    if token in self._token_to_idx:
+                        continue
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+                    vectors.append(_np.asarray(vals, _np.float32))
+        self._mat = jnp.asarray(_np.stack(vectors)) if vectors else None
+        self._vocab = vocabulary
+        if vocabulary is not None:
+            self._mat = self._build_for_vocab(vocabulary)
+
+    def _build_for_vocab(self, vocab):
+        import jax.numpy as jnp
+        dim = self.vec_len
+        rows = _np.zeros((len(vocab), dim), _np.float32)
+        unk = _np.asarray(self._init_unknown(dim), _np.float32)
+        for i, token in enumerate(vocab.idx_to_token):
+            j = self._token_to_idx.get(token)
+            rows[i] = _np.asarray(self._mat[j]) if j is not None else unk
+        return jnp.asarray(rows)
+
+    @property
+    def vec_len(self):
+        return self._vec_len or 0
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        return _wrap(self._mat) if self._mat is not None else None
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        import jax.numpy as jnp
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        rows = []
+        lookup = self._vocab.token_to_idx if self._vocab is not None \
+            else self._token_to_idx
+        for t in toks:
+            j = lookup.get(t)
+            if j is None and lower_case_backup:
+                j = lookup.get(t.lower())
+            if j is None:
+                rows.append(_np.asarray(self._init_unknown(self.vec_len),
+                                        _np.float32))
+            else:
+                rows.append(_np.asarray(self._mat[j]))
+        out = jnp.asarray(_np.stack(rows))
+        return _wrap(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        import jax.numpy as jnp
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vecs = new_vectors._data if isinstance(new_vectors, NDArray) \
+            else jnp.asarray(new_vectors)
+        if vecs.ndim == 1:
+            vecs = vecs[None, :]
+        lookup = self._vocab.token_to_idx if self._vocab is not None \
+            else self._token_to_idx
+        idx = [lookup[t] for t in toks]
+        self._mat = self._mat.at[jnp.asarray(idx)].set(vecs)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Local-file embedding (reference embedding.py CustomEmbedding)."""
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe-format loader; needs a local file (no egress here)."""
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 embedding_root=None, **kwargs):
+        path = kwargs.pop("pretrained_file_path", None)
+        if path is None:
+            root = embedding_root or os.path.expanduser("~/.mxnet_tpu/emb")
+            path = os.path.join(root, pretrained_file_name)
+        super().__init__(pretrained_file_path=path, **kwargs)
+
+
+@register
+class FastText(GloVe):
+    """fastText .vec loader (same line format; header line skipped)."""
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec", **kwargs):
+        super().__init__(pretrained_file_name=pretrained_file_name, **kwargs)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenates several embeddings per token
+    (reference embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        import jax.numpy as jnp
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._vocab = vocabulary
+        self._token_to_idx = vocabulary.token_to_idx
+        self._idx_to_token = vocabulary.idx_to_token
+        mats = []
+        for emb in token_embeddings:
+            mats.append(emb._build_for_vocab(vocabulary))
+        self._mat = jnp.concatenate(mats, axis=1)
+        self._vec_len = int(self._mat.shape[1])
+        self._init_unknown = lambda d: _np.zeros(d)

@@ -1,0 +1,77 @@
+"""Vocabulary — token <-> index mapping.
+
+Reference: python/mxnet/contrib/text/vocab.py:30 Vocabulary (counter-based
+construction, most_freq_count/min_freq filters, unknown + reserved tokens).
+"""
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens or \
+                len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError("reserved tokens must be unique and must not "
+                             "contain the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._add_counter(counter, most_freq_count, min_freq)
+
+    def _add_counter(self, counter, most_freq_count, min_freq):
+        # frequency-sorted, ties broken alphabetically (reference order)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        room = None if most_freq_count is None else most_freq_count
+        for token, freq in pairs:
+            if freq < min_freq or token in self._token_to_idx:
+                continue
+            if room is not None and room <= 0:
+                break
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            if room is not None:
+                room -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError("token index %d out of range" % i)
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out

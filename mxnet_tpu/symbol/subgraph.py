@@ -136,20 +136,24 @@ def build_subgraph(sym, prop):
     """
     from .symbol import Symbol, Group, _topo
 
-    # consumer counts on the original DAG (op-node uses only)
+    # consumer counts on the original DAG (op-node uses only); graph heads
+    # count as escapes too — a head's output is externally visible, so it
+    # must never be absorbed into a consumer's group
     consumers = {}
     for n in _topo(sym):
         if n.kind == "op":
             for x in n.inputs:
                 if isinstance(x, Symbol):
                     consumers[id(x)] = consumers.get(id(x), 0) + 1
+    head_ids = {id(h) for h in sym._heads()}
 
     def absorb(node):
         """The group whose sink is `node`, producers first."""
         out = []
         for x in node.inputs:
             if isinstance(x, Symbol) and x.kind == "op" and \
-                    prop.select(x) and consumers.get(id(x), 0) == 1:
+                    prop.select(x) and consumers.get(id(x), 0) == 1 and \
+                    id(x) not in head_ids:
                 out.extend(absorb(x))
         out.append(node)
         return out

@@ -1,0 +1,110 @@
+"""contrib.text (vocab + embeddings) and contrib.svrg_optimization
+(reference: tests/python/unittest/test_contrib_text.py,
+test_contrib_svrg_module.py / test_contrib_svrg_optimizer.py)."""
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule, SVRGOptimizer
+
+
+def test_count_tokens_and_vocabulary():
+    counter = text.utils.count_tokens_from_str(
+        "a b b c c c\nd d d d", to_lower=False)
+    assert counter == Counter({"d": 4, "c": 3, "b": 2, "a": 1})
+    vocab = text.Vocabulary(counter, most_freq_count=2, min_freq=1,
+                            unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # unk + pad + 2 most frequent
+    assert len(vocab) == 4
+    assert vocab.to_indices("d") == 2
+    assert vocab.to_indices(["c", "zzz"]) == [3, 0]
+    assert vocab.to_tokens(3) == "c"
+    with pytest.raises(ValueError):
+        vocab.to_tokens(99)
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+
+
+def test_custom_embedding_from_file(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(pretrained_file_path=str(path))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(v, [4, 5, 6])
+    # unknown -> zeros
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("missing").asnumpy(), [0, 0, 0])
+    # vocabulary-aligned matrix
+    vocab = text.Vocabulary(Counter({"world": 2, "hello": 1}))
+    emb2 = text.embedding.CustomEmbedding(pretrained_file_path=str(path),
+                                          vocabulary=vocab)
+    mat = emb2.idx_to_vec.asnumpy()
+    assert mat.shape == (3, 3)
+    np.testing.assert_allclose(mat[vocab.to_indices("hello")], [1, 2, 3])
+    # update vectors in place
+    emb2.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("x 1.0 1.0\ny 2.0 2.0\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("x 3.0\ny 4.0\n")
+    vocab = text.Vocabulary(Counter({"x": 1, "y": 1}))
+    comp = text.embedding.CompositeEmbedding(
+        vocab, [text.embedding.CustomEmbedding(str(p1)),
+                text.embedding.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("y").asnumpy(), [2, 2, 4])
+
+
+def test_glove_missing_file_guidance():
+    with pytest.raises(OSError, match="egress"):
+        text.embedding.GloVe(pretrained_file_name="nope.txt",
+                             embedding_root="/tmp/definitely-missing")
+
+
+def test_onnx_gate_points_at_stablehlo():
+    from mxnet_tpu.contrib import onnx as monnx
+    with pytest.raises((ImportError, NotImplementedError),
+                       match="StableHLO"):
+        monnx.import_model("m.onnx")
+
+
+def test_svrg_optimizer_correction():
+    g = np.array([1.0, 2.0], np.float32)
+    snap = np.array([0.5, 0.5], np.float32)
+    mu = np.array([0.1, 0.1], np.float32)
+    out = SVRGOptimizer.correct(g, snap, mu)
+    np.testing.assert_allclose(out, g - snap + mu)
+
+
+def test_svrg_module_trains():
+    """SVRGModule.fit converges on a linear-separable problem and matches
+    plain Module accuracy (the reference test's contract: training works
+    and the full-grad schedule runs)."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(64, 6)).astype(np.float32)
+    W = rng.normal(size=(6, 3)).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+
+    mod = SVRGModule(out, update_freq=2)
+    train = mx.io.NDArrayIter(X, Y, batch_size=16)
+    em = mod.fit(train, num_epoch=8, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.5},
+                 initializer=mx.init.Xavier())
+    assert mod._mu is not None and mod._snapshot is not None
+    acc = mod.score(mx.io.NDArrayIter(X, Y, batch_size=16), "acc")[0][1]
+    assert acc > 0.8, acc

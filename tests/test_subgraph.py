@@ -184,3 +184,41 @@ def test_subgraph_shared_producer_not_absorbed():
     av = np.array([-1.0, 3.0], np.float32)
     np.testing.assert_allclose(_eval(fused, a=av),
                                2 * np.maximum(av, 0))
+
+
+def test_subgraph_head_output_not_absorbed():
+    """A selected node that is also a GRAPH HEAD escapes the group even
+    with a single op consumer — absorbing it would duplicate its compute
+    (regression for the head-escape rule)."""
+    class P(subgraph.SubgraphProperty):
+        def select(self, node):
+            return node.op in ("relu", "broadcast_add")
+
+        def create_subgraph_node(self, nodes, inputs):
+            from mxnet_tpu.symbol.symbol import _make_op_node
+            inside = {id(n) for n in nodes}
+            rebuilt = {}
+            it = iter(inputs)
+            out = None
+            for n in nodes:
+                args = [rebuilt[id(x)] if id(x) in inside else next(it)
+                        for x in n.inputs]
+                out = _make_op_node(n.op, args, dict(n.attrs))
+                rebuilt[id(n)] = out
+            return out
+
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    x = mx.sym.relu(a)
+    y = x + b
+    g = mx.sym.Group([x, y])
+    fused = subgraph.build_subgraph(g, P())
+    relus = [n for n in _topo(fused) if n.op == "relu"]
+    assert len(relus) == 1, "head relu must stay shared, not duplicated"
+    av = np.array([-1.0, 2.0], np.float32)
+    bv = np.array([0.5, 0.5], np.float32)
+    ex = fused.bind(None, {"a": mx.nd.array(av), "b": mx.nd.array(bv)})
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), np.maximum(av, 0))
+    np.testing.assert_allclose(outs[1].asnumpy(),
+                               np.maximum(av, 0) + bv)
