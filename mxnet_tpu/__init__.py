@@ -57,6 +57,7 @@ _LAZY = {
     "deploy": ".deploy",
     "config": ".config",
     "library": ".library",
+    "rtc": ".rtc",
 }
 
 

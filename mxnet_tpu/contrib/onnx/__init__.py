@@ -29,21 +29,26 @@ def _require_onnx():
         raise ImportError(_GUIDANCE) from None
 
 
+_INSTALLED_GUIDANCE = (
+    "ONNX interchange is not implemented in this framework; the TPU-native "
+    "format is StableHLO — use mx.deploy.export_model / mx.deploy.load_model "
+    "(serialized XLA program + params, reloadable from any process)."
+)
+
+
 def import_model(model_file):
     """Reference: contrib/onnx/onnx2mx/import_model.py."""
     _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph import is not implemented; " + _GUIDANCE)
+    raise NotImplementedError(_INSTALLED_GUIDANCE)
 
 
 def export_model(sym, params, input_shape, input_type=None,
                  onnx_file_path="model.onnx", verbose=False):
     """Reference: contrib/onnx/mx2onnx/export_model.py."""
     _require_onnx()
-    raise NotImplementedError(
-        "ONNX export is not implemented; " + _GUIDANCE)
+    raise NotImplementedError(_INSTALLED_GUIDANCE)
 
 
 def get_model_metadata(model_file):
     _require_onnx()
-    raise NotImplementedError(_GUIDANCE)
+    raise NotImplementedError(_INSTALLED_GUIDANCE)

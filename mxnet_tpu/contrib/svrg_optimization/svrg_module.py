@@ -110,16 +110,28 @@ class SVRGModule(Module):
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params or
                             {"learning_rate": 0.01})
+        from ...callback import BatchEndParam
         em = metric_mod.create(eval_metric)
         for epoch in range(begin_epoch, num_epoch or 1):
             if (epoch - begin_epoch) % self.update_freq == 0:
                 self.update_full_grads(train_data)
             em.reset()
             train_data.reset()
-            for batch in train_data:
+            for nbatch, batch in enumerate(train_data):
                 self._svrg_corrected_update(batch)
                 self.update_metric(em, batch.label)
+                if batch_end_callback:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=em, locals=locals())
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) else \
+                        [batch_end_callback]
+                    for cb in cbs:
+                        cb(params)
             if epoch_end_callback:
                 epoch_end_callback(epoch, self._symbol,
                                    *self.get_params())
+            if eval_data is not None:
+                res = self.score(eval_data, metric_mod.create(eval_metric))
+                self.logger.info("Epoch[%d] validation: %s", epoch, res)
         return em

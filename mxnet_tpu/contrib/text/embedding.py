@@ -97,11 +97,14 @@ class TokenEmbedding:
     def _build_for_vocab(self, vocab):
         import jax.numpy as jnp
         dim = self.vec_len
+        # ONE device->host copy, then host-side row assembly (per-token
+        # device gathers would be a round-trip per vocab entry)
+        mat_np = _np.asarray(self._mat) if self._mat is not None else None
         rows = _np.zeros((len(vocab), dim), _np.float32)
         unk = _np.asarray(self._init_unknown(dim), _np.float32)
         for i, token in enumerate(vocab.idx_to_token):
             j = self._token_to_idx.get(token)
-            rows[i] = _np.asarray(self._mat[j]) if j is not None else unk
+            rows[i] = mat_np[j] if j is not None else unk
         return jnp.asarray(rows)
 
     @property

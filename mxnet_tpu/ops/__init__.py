@@ -10,5 +10,6 @@ from . import random_ops  # noqa: F401  (registers _random_*/sample_* ops)
 from . import spatial  # noqa: F401  (registers sampler/warp/deformable ops)
 from . import signal  # noqa: F401  (registers fft/ifft)
 from . import optim_ops  # noqa: F401  (registers *_update optimizer ops)
+from . import pallas_kernels  # noqa: F401  (registers pallas_* kernels)
 
 __all__ = ["Operator", "apply_op", "get", "invoke", "list_ops", "register"]

@@ -1,0 +1,95 @@
+"""Built-in Pallas kernels — the custom-kernel escape hatch in use.
+
+Reference role: the hand-written CUDA kernels MXNet reaches for when
+library kernels fall short (RTC, src/common/rtc.cc; fused contrib kernels).
+On TPU the escape hatch is Mosaic via Pallas (pallas_guide.md); these
+kernels double as the worked examples for ``mx.rtc``.
+
+Each kernel follows the VMEM-block pattern: the grid walks row blocks, a
+block lives in VMEM, and the body is VPU elementwise math with on-chip
+reductions — no HBM roundtrips between the fused stages.  On CPU they run
+through the Pallas interpreter (same numerics), so tests validate the
+kernels without a TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["pallas_row_softmax", "pallas_scale_bias_relu"]
+
+
+def _row_softmax_kernel(x_ref, o_ref):
+    """Numerically-stable softmax over the last axis of one row block.
+    max/sum reductions stay in VMEM — one HBM read, one HBM write."""
+    x = x_ref[:]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[:] = e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def _scale_bias_relu_kernel(x_ref, scale_ref, bias_ref, o_ref):
+    """Fused y = relu(x * scale + bias) — the classic post-GEMM epilogue."""
+    o_ref[:] = jnp.maximum(x_ref[:] * scale_ref[:] + bias_ref[:], 0.0)
+
+
+def _row_block(n_rows, row_bytes, budget=2 << 20):
+    """Largest divisor of n_rows whose block stays under the VMEM budget
+    (a block must tile the array exactly)."""
+    cap = max(1, budget // max(row_bytes, 1))
+    best = 1
+    for d in range(1, n_rows + 1):
+        if n_rows % d == 0 and d <= cap:
+            best = d
+    return best
+
+
+@register("pallas_softmax", differentiable=False)
+def pallas_row_softmax(data, **_):
+    """Row softmax via the Pallas kernel (mx.nd.pallas_softmax).
+
+    The grid walks row blocks sized to fit VMEM, so arbitrarily tall
+    logits tensors stream through the kernel; one row must fit on chip
+    (true for any real vocab at fp32: 32k cols = 128KB)."""
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    x = jnp.asarray(data)
+    flat = x.reshape(-1, x.shape[-1])
+    n, d = flat.shape
+    rows = _row_block(n, d * flat.dtype.itemsize)
+    out = pl.pallas_call(
+        _row_softmax_kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        interpret=interpret_mode())(flat)
+    return out.reshape(x.shape)
+
+
+@register("pallas_scale_bias_relu", differentiable=False)
+def pallas_scale_bias_relu(data, scale, bias, **_):
+    """Fused per-feature epilogue y = relu(x*scale + bias)
+    (mx.nd.pallas_scale_bias_relu); scale/bias broadcast over the last
+    axis INSIDE the kernel, so HBM reads stay B*D + 2*D."""
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    x = jnp.asarray(data)
+    d = x.shape[-1]
+    flat = x.reshape(-1, d)
+    n = flat.shape[0]
+    s = jnp.asarray(scale).reshape(1, d).astype(x.dtype)
+    b = jnp.asarray(bias).reshape(1, d).astype(x.dtype)
+    rows = _row_block(n, d * flat.dtype.itemsize)
+    out = pl.pallas_call(
+        _scale_bias_relu_kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        interpret=interpret_mode())(flat, s, b)
+    return out.reshape(x.shape)

@@ -656,6 +656,14 @@ spec("_contrib_quantized_conv",
             "no_bias": True},
      fwd_only="int8 execution path; accuracy covered in test_contrib")
 
+spec("pallas_softmax", inputs=lambda: [rnd(3, 8)],
+     ref=lambda x, **_: np.exp(x - x.max(-1, keepdims=True)) /
+     np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+     fwd_only="pallas kernel; registered non-differentiable")
+spec("pallas_scale_bias_relu", inputs=lambda: [rnd(3, 8), pos(8), rnd(8)],
+     ref=lambda x, s, b, **_: np.maximum(x * s + b, 0),
+     fwd_only="pallas kernel; registered non-differentiable")
+
 # MultiBoxTarget/Detection-style ops registered under other names get their
 # own specs here if present; the meta test below catches any addition that
 # forgets to add one.
