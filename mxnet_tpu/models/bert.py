@@ -1,0 +1,146 @@
+"""BERT — bidirectional encoder with MLM + NSP pretraining heads.
+
+BASELINE.json config #3 is "BERT-base pretraining (Gluon-NLP, hybridize +
+dist kvstore)".  The Gluon-NLP reference stacks the same transformer blocks
+this framework already ships (models/transformer.py); BERT adds token-type
+embeddings, a [CLS] pooler, the masked-LM head (tied to the embedding
+matrix) and the next-sentence head.
+
+TPU-native: the encoder is TransformerLM's scanned-layer stack with
+``causal=False`` (bidirectional attention), so every sharding the flagship
+model has — batch on 'dp', Megatron head/MLP splits on 'tp', ring-attention
+sequence sharding on 'sp' — applies to BERT pretraining unchanged.  The
+pretraining loss masks out non-masked positions with gather, not dynamic
+shapes, keeping the whole step one static XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import TransformerLM, TransformerLMConfig, _norm
+
+__all__ = ["BERTConfig", "BERT", "bert_base"]
+
+
+class BERTConfig(TransformerLMConfig):
+    def __init__(self, vocab_size=30522, num_layers=12, d_model=768,
+                 num_heads=12, d_ff=3072, max_len=512, type_vocab=2,
+                 dtype=jnp.bfloat16):
+        super().__init__(vocab_size=vocab_size, num_layers=num_layers,
+                         d_model=d_model, num_heads=num_heads, d_ff=d_ff,
+                         max_len=max_len, dtype=dtype, causal=False)
+        self.type_vocab = type_vocab
+
+
+def bert_base(**overrides):
+    return BERTConfig(**overrides)
+
+
+class BERT:
+    """Encoder + pretraining heads over the shared transformer stack."""
+
+    def __init__(self, config, mesh=None):
+        self.cfg = config
+        self.encoder = TransformerLM(config, mesh=mesh)
+
+    # -------------------------------------------------------------- params
+    def init(self, key):
+        cfg = self.cfg
+        k_enc, k_type, k_pool, k_nsp, k_mlm = jax.random.split(key, 5)
+        params = self.encoder.init(k_enc)
+        D = cfg.d_model
+        init = jax.nn.initializers.normal(0.02)
+        params["type_embed"] = init(k_type, (cfg.type_vocab, D),
+                                    jnp.float32).astype(cfg.dtype)
+        params["pooler_w"] = init(k_pool, (D, D),
+                                  jnp.float32).astype(cfg.dtype)
+        params["pooler_b"] = jnp.zeros((D,), cfg.dtype)
+        params["nsp_w"] = init(k_nsp, (D, 2), jnp.float32).astype(cfg.dtype)
+        params["nsp_b"] = jnp.zeros((2,), cfg.dtype)
+        # MLM transform before the tied-embedding projection
+        params["mlm_w"] = init(k_mlm, (D, D), jnp.float32).astype(cfg.dtype)
+        params["mlm_b"] = jnp.zeros((D,), cfg.dtype)
+        params["mlm_norm"] = jnp.ones((D,), cfg.dtype)
+        params["mlm_bias_v"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
+        return params
+
+    def param_specs(self):
+        specs = self.encoder.param_specs()
+        tp = self.encoder._tp
+        specs.update({
+            "type_embed": P(None, None),
+            "pooler_w": P(None, tp),
+            "pooler_b": P(tp),
+            "nsp_w": P(None, None),
+            "nsp_b": P(None),
+            "mlm_w": P(None, tp),
+            "mlm_b": P(tp),
+            "mlm_norm": P(None),
+            "mlm_bias_v": P(None),
+        })
+        return specs
+
+    # ------------------------------------------------------------- forward
+    def encode(self, params, tokens, token_types):
+        """tokens/token_types [B, S] int32 -> hidden [B, S, D].  The stack
+        itself is TransformerLM.run_stack — BERT only embeds differently
+        (adds type embeddings) before it."""
+        cfg = self.cfg
+        S = tokens.shape[1]
+        x = (params["embed"][tokens]
+             + params["pos_embed"][:S][None]
+             + params["type_embed"][token_types])
+        return self.encoder.run_stack(params, x.astype(cfg.dtype))
+
+    def apply(self, params, tokens, token_types):
+        """-> (sequence_hidden [B,S,D], pooled [B,D]) — the Gluon-NLP
+        BERTModel output pair."""
+        h = self.encode(params, tokens, token_types)
+        pooled = jnp.tanh(
+            jnp.einsum("bd,de->be", h[:, 0].astype(jnp.float32),
+                       params["pooler_w"].astype(jnp.float32))
+            + params["pooler_b"].astype(jnp.float32))
+        return h, pooled
+
+    def mlm_logits(self, params, hidden, positions):
+        """Gather masked positions [B, M] and project to vocab with the
+        TIED embedding matrix (BERT's weight tying)."""
+        g = jnp.take_along_axis(
+            hidden, positions[..., None].astype(jnp.int32), axis=1)
+        t = jnp.einsum("bmd,de->bme", g.astype(jnp.float32),
+                       params["mlm_w"].astype(jnp.float32))
+        t = jax.nn.gelu(t)
+        t = _norm(t.astype(self.cfg.dtype), params["mlm_norm"])
+        return jnp.einsum("bmd,vd->bmv", t.astype(jnp.float32),
+                          params["embed"].astype(jnp.float32)) \
+            + params["mlm_bias_v"]
+
+    # ---------------------------------------------------------------- loss
+    def pretrain_loss(self, params, tokens, token_types, mlm_positions,
+                      mlm_labels, mlm_weights, nsp_labels):
+        """Masked-LM + next-sentence loss, all static shapes.
+
+        mlm_positions/labels/weights are padded to a fixed M per example
+        (weights 0 on padding) — the standard static-shape BERT batch
+        layout, which is exactly what XLA wants.
+        """
+        hidden, pooled = self.apply(params, tokens, token_types)
+        logits = self.mlm_logits(params, hidden, mlm_positions)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, mlm_labels[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        w = mlm_weights.astype(jnp.float32)
+        mlm = jnp.sum((logz - gold) * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+        nsp_logits = jnp.einsum("bd,dc->bc", pooled,
+                                params["nsp_w"].astype(jnp.float32)) \
+            + params["nsp_b"].astype(jnp.float32)
+        nlogz = jax.nn.logsumexp(nsp_logits, axis=-1)
+        ngold = jnp.take_along_axis(
+            nsp_logits, nsp_labels[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        nsp = jnp.mean(nlogz - ngold)
+        return mlm + nsp

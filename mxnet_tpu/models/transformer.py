@@ -155,19 +155,24 @@ class TransformerLM:
         x = x + d
         return self._constrain(x, self._dp, self._sp, None)
 
-    def apply(self, params, tokens):
-        """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
-        cfg = self.cfg
-        S = tokens.shape[1]
-        x = params["embed"][tokens] + params["pos_embed"][:S][None]
-        x = x.astype(cfg.dtype)
+    def run_stack(self, params, x):
+        """Shared encoder body: sharding constraint -> scanned layers ->
+        final norm.  Used by apply() and by models embedding differently
+        before the stack (models/bert.py)."""
         x = self._constrain(x, self._dp, self._sp, None)
 
         def body(carry, lp):
             return self._layer(carry, lp), None
 
         x, _ = lax.scan(body, x, params["layers"])
-        x = _norm(x, params["final_norm"])
+        return _norm(x, params["final_norm"])
+
+    def apply(self, params, tokens):
+        """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
+        cfg = self.cfg
+        S = tokens.shape[1]
+        x = params["embed"][tokens] + params["pos_embed"][:S][None]
+        x = self.run_stack(params, x.astype(cfg.dtype))
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"],
                             preferred_element_type=jnp.float32)
         return logits

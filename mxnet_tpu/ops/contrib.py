@@ -358,3 +358,137 @@ def _quantized_conv(data, weight, bias=None, amax_data=1.0, amax_weight=1.0,
     if bias is not None and not no_bias:
         out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
     return out
+
+
+@register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
+          differentiable=False, num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=3.0,
+                     negative_mining_thresh=0.5,
+                     variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """SSD training targets (reference:
+    src/operator/contrib/multibox_target.cc).
+
+    anchor (1, N, 4) corner boxes; label (B, M, 5) rows [cls, x1, y1, x2,
+    y2] padded with -1; cls_pred (B, C+1, N).  Returns (box_target (B,N*4),
+    box_mask (B,N*4), cls_target (B,N)) — matched anchors regress their gt
+    with variance scaling, background anchors are hard-negative-mined to
+    ``negative_mining_ratio`` x positives by max non-background score, the
+    rest get ignore_label.  All static shapes (sorting replaces the
+    reference's dynamic queues).
+    """
+    a = jnp.asarray(anchor)[0]                       # (N, 4)
+    lab = jnp.asarray(label)
+    cp = jnp.asarray(cls_pred)
+    B, M, _ = lab.shape
+    N = a.shape[0]
+    var = jnp.asarray(variances)
+
+    def one(lab_b, cp_b):
+        valid = lab_b[:, 0] >= 0                     # (M,)
+        gt = lab_b[:, 1:5]
+        iou = _corner_iou(a[:, None, :], gt[None, :, :])   # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)            # per-anchor best gt
+        best_iou = jnp.max(iou, axis=1)
+        # forced match: each valid gt claims its best anchor.  Scatters
+        # accumulate (add/max) so an INVALID gt row can never overwrite a
+        # valid gt's claim when their argmax indices collide.
+        best_anchor = jnp.argmax(iou, axis=0)        # (M,)
+        claims = jnp.zeros((N,), jnp.int32).at[best_anchor].add(
+            valid.astype(jnp.int32))
+        forced = claims > 0
+        forced_gt = jnp.full((N,), -1, jnp.int32).at[best_anchor].max(
+            jnp.where(valid, jnp.arange(M, dtype=jnp.int32), -1))
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, jnp.maximum(forced_gt, 0), best_gt)
+        # regression targets (center-offset encoding, variance scaled)
+        g = gt[gt_idx]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        ax = (a[:, 0] + a[:, 2]) / 2
+        ay = (a[:, 1] + a[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gx = (g[:, 0] + g[:, 2]) / 2
+        gy = (g[:, 1] + g[:, 3]) / 2
+        t = jnp.stack([((gx - ax) / jnp.maximum(aw, 1e-12)) / var[0],
+                       ((gy - ay) / jnp.maximum(ah, 1e-12)) / var[1],
+                       jnp.log(gw / jnp.maximum(aw, 1e-12)) / var[2],
+                       jnp.log(gh / jnp.maximum(ah, 1e-12)) / var[3]],
+                      axis=1)                        # (N, 4)
+        box_t = jnp.where(matched[:, None], t, 0.0).reshape(-1)
+        box_m = jnp.where(matched[:, None],
+                          jnp.ones((N, 4)), 0.0).reshape(-1)
+        # hard negative mining: unmatched anchors BELOW the mining-iou
+        # threshold are negative candidates; keep ratio * num_pos of them
+        # (ranked by max foreground score) as background, ignore the rest.
+        # ratio <= 0 disables mining: every candidate is background
+        # (reference default -1, multibox_target.cc).
+        neg_cand = ~matched & (best_iou < negative_mining_thresh)
+        if negative_mining_ratio > 0:
+            fg_score = jnp.max(cp_b[1:], axis=0)     # (N,)
+            order = jnp.argsort(jnp.where(neg_cand, -fg_score, jnp.inf))
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            n_pos = jnp.sum(matched.astype(jnp.int32))
+            keep_neg = neg_cand & (rank < (negative_mining_ratio
+                                           * jnp.maximum(n_pos, 1)))
+        else:
+            keep_neg = neg_cand
+        cls_t = jnp.where(matched, lab_b[gt_idx, 0] + 1.0,
+                          jnp.where(keep_neg, 0.0, ignore_label))
+        return box_t, box_m, cls_t
+
+    box_t, box_m, cls_t = jax.vmap(one)(lab, cp)
+    return box_t, box_m, cls_t
+
+
+@register("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",),
+          differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, nms_threshold=0.5,
+                        force_suppress=False, nms_topk=-1,
+                        variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """SSD decode + per-class NMS (reference:
+    src/operator/contrib/multibox_detection.cc).
+
+    cls_prob (B, C+1, N) softmax scores (class 0 = background); loc_pred
+    (B, N*4); anchor (1, N, 4).  Output (B, N, 6) rows
+    [class_id, score, x1, y1, x2, y2], suppressed rows class_id = -1 —
+    the static-shape convention shared with box_nms.
+    """
+    cp = jnp.asarray(cls_prob)
+    lp = jnp.asarray(loc_pred)
+    a = jnp.asarray(anchor)[0]
+    B, C1, N = cp.shape
+    var = jnp.asarray(variances)
+
+    aw = a[:, 2] - a[:, 0]
+    ah = a[:, 3] - a[:, 1]
+    ax = (a[:, 0] + a[:, 2]) / 2
+    ay = (a[:, 1] + a[:, 3]) / 2
+
+    def one(cp_b, lp_b):
+        d = lp_b.reshape(N, 4)
+        cx = d[:, 0] * var[0] * aw + ax
+        cy = d[:, 1] * var[1] * ah + ay
+        w = jnp.exp(d[:, 2] * var[2]) * aw / 2
+        h = jnp.exp(d[:, 3] * var[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        cls_id = jnp.argmax(cp_b[1:], axis=0).astype(jnp.float32)  # (N,)
+        score = jnp.max(cp_b[1:], axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None], boxes], axis=1)
+        out = _nms_one(rows, valid_thresh=0.0,
+                       overlap_thresh=nms_threshold, topk=int(nms_topk),
+                       score_index=1, coord_start=2, id_index=0,
+                       force_suppress=bool(force_suppress))
+        # reference convention: suppressed rows carry class_id -1
+        return out.at[:, 0].set(jnp.where(out[:, 1] > 0, out[:, 0], -1.0))
+
+    return jax.vmap(one)(cp, lp)

@@ -37,12 +37,19 @@ def _scale_bias_relu_kernel(x_ref, scale_ref, bias_ref, o_ref):
 
 def _row_block(n_rows, row_bytes, budget=2 << 20):
     """Largest divisor of n_rows whose block stays under the VMEM budget
-    (a block must tile the array exactly)."""
+    (a block must tile the array exactly).  O(sqrt(n)) divisor walk — this
+    runs on the host per eager call, so no linear scans."""
     cap = max(1, budget // max(row_bytes, 1))
     best = 1
-    for d in range(1, n_rows + 1):
-        if n_rows % d == 0 and d <= cap:
-            best = d
+    i = 1
+    while i * i <= n_rows:
+        if n_rows % i == 0:
+            if i <= cap and i > best:
+                best = i
+            j = n_rows // i
+            if j <= cap and j > best:
+                best = j
+        i += 1
     return best
 
 
