@@ -110,11 +110,14 @@ class BERT:
         g = jnp.take_along_axis(
             hidden, positions[..., None].astype(jnp.int32), axis=1)
         t = jnp.einsum("bmd,de->bme", g.astype(jnp.float32),
-                       params["mlm_w"].astype(jnp.float32))
+                       params["mlm_w"].astype(jnp.float32)) \
+            + params["mlm_b"].astype(jnp.float32)
         t = jax.nn.gelu(t)
         t = _norm(t.astype(self.cfg.dtype), params["mlm_norm"])
-        return jnp.einsum("bmd,vd->bmv", t.astype(jnp.float32),
-                          params["embed"].astype(jnp.float32)) \
+        # tied-embedding projection on the MXU: bf16 operands, f32
+        # accumulation (same form as transformer.apply's logits matmul)
+        return jnp.einsum("bmd,vd->bmv", t, params["embed"],
+                          preferred_element_type=jnp.float32) \
             + params["mlm_bias_v"]
 
     # ---------------------------------------------------------------- loss

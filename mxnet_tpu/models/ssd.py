@@ -14,10 +14,7 @@ single XLA programs.
 """
 from __future__ import annotations
 
-import numpy as _np
-
 from ..gluon import nn, HybridBlock
-from ..ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["SSD", "ssd_512", "MultiBoxLoss"]
 
@@ -91,7 +88,11 @@ class SSD(HybridBlock):
         reference's (B, C+1, N) layout internally)."""
         from ..ops.registry import invoke
         return invoke("MultiBoxTarget", anchors,
-                      labels, cls_preds.transpose((0, 2, 1)))
+                      labels, cls_preds.transpose((0, 2, 1)),
+                      # SSD recipe: 3:1 hard-negative mining (the op itself
+                      # defaults to mining OFF, matching the reference op)
+                      negative_mining_ratio=3.0,
+                      negative_mining_thresh=0.5)
 
     def detect(self, anchors, cls_preds, box_preds, nms_threshold=0.45,
                threshold=0.01):

@@ -363,7 +363,7 @@ def _quantized_conv(data, weight, bias=None, amax_data=1.0, amax_weight=1.0,
 @register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
           differentiable=False, num_outputs=3)
 def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
-                     ignore_label=-1.0, negative_mining_ratio=3.0,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
                      negative_mining_thresh=0.5,
                      variances=(0.1, 0.1, 0.2, 0.2), **_):
     """SSD training targets (reference:
@@ -382,7 +382,6 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     cp = jnp.asarray(cls_pred)
     B, M, _ = lab.shape
     N = a.shape[0]
-    var = jnp.asarray(variances)
 
     def one(lab_b, cp_b):
         valid = lab_b[:, 0] >= 0                     # (M,)
@@ -391,35 +390,23 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         iou = jnp.where(valid[None, :], iou, -1.0)
         best_gt = jnp.argmax(iou, axis=1)            # per-anchor best gt
         best_iou = jnp.max(iou, axis=1)
-        # forced match: each valid gt claims its best anchor.  Scatters
-        # accumulate (add/max) so an INVALID gt row can never overwrite a
-        # valid gt's claim when their argmax indices collide.
-        best_anchor = jnp.argmax(iou, axis=0)        # (M,)
-        claims = jnp.zeros((N,), jnp.int32).at[best_anchor].add(
-            valid.astype(jnp.int32))
-        forced = claims > 0
-        forced_gt = jnp.full((N,), -1, jnp.int32).at[best_anchor].max(
-            jnp.where(valid, jnp.arange(M, dtype=jnp.int32), -1))
+        # Forced matching is greedy bipartite, like the reference: each
+        # valid gt with POSITIVE overlap claims the globally-best remaining
+        # anchor (a per-gt argmax scatter would drop a gt when two gts
+        # share a best anchor).  Reuses the bipartite_matching op's claim-
+        # and-retire scan; the threshold keeps zero-IoU gts from force-
+        # claiming an arbitrary anchor.
+        forced_gt_f, _ = _bipartite_matching(iou, is_ascend=False,
+                                             threshold=1e-12)
+        forced_gt = forced_gt_f.astype(jnp.int32)
+        forced = forced_gt >= 0
         matched = forced | (best_iou >= overlap_threshold)
         gt_idx = jnp.where(forced, jnp.maximum(forced_gt, 0), best_gt)
-        # regression targets (center-offset encoding, variance scaled)
-        g = gt[gt_idx]
-        aw = a[:, 2] - a[:, 0]
-        ah = a[:, 3] - a[:, 1]
-        ax = (a[:, 0] + a[:, 2]) / 2
-        ay = (a[:, 1] + a[:, 3]) / 2
-        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
-        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
-        gx = (g[:, 0] + g[:, 2]) / 2
-        gy = (g[:, 1] + g[:, 3]) / 2
-        t = jnp.stack([((gx - ax) / jnp.maximum(aw, 1e-12)) / var[0],
-                       ((gy - ay) / jnp.maximum(ah, 1e-12)) / var[1],
-                       jnp.log(gw / jnp.maximum(aw, 1e-12)) / var[2],
-                       jnp.log(gh / jnp.maximum(ah, 1e-12)) / var[3]],
-                      axis=1)                        # (N, 4)
-        box_t = jnp.where(matched[:, None], t, 0.0).reshape(-1)
-        box_m = jnp.where(matched[:, None],
-                          jnp.ones((N, 4)), 0.0).reshape(-1)
+        # regression targets: shared center-offset encoder (box_encode op)
+        t, m = _box_encode(matched.astype(jnp.float32), gt_idx, a, gt,
+                           stds=tuple(float(v) for v in variances))
+        box_t = t.reshape(-1)
+        box_m = jnp.broadcast_to(m, t.shape).reshape(-1)
         # hard negative mining: unmatched anchors BELOW the mining-iou
         # threshold are negative candidates; keep ratio * num_pos of them
         # (ranked by max foreground score) as background, ignore the rest.
@@ -462,20 +449,12 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     lp = jnp.asarray(loc_pred)
     a = jnp.asarray(anchor)[0]
     B, C1, N = cp.shape
-    var = jnp.asarray(variances)
-
-    aw = a[:, 2] - a[:, 0]
-    ah = a[:, 3] - a[:, 1]
-    ax = (a[:, 0] + a[:, 2]) / 2
-    ay = (a[:, 1] + a[:, 3]) / 2
+    v0, v1, v2, v3 = (float(v) for v in variances)
 
     def one(cp_b, lp_b):
-        d = lp_b.reshape(N, 4)
-        cx = d[:, 0] * var[0] * aw + ax
-        cy = d[:, 1] * var[1] * ah + ay
-        w = jnp.exp(d[:, 2] * var[2]) * aw / 2
-        h = jnp.exp(d[:, 3] * var[3]) * ah / 2
-        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        # shared variance-scaled decoder (box_decode op); MultiBoxDetection
+        # additionally clips the OUTPUT corners to the unit image
+        boxes = _box_decode(lp_b.reshape(N, 4), a, v0, v1, v2, v3)
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
         cls_id = jnp.argmax(cp_b[1:], axis=0).astype(jnp.float32)  # (N,)

@@ -157,30 +157,60 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     # elementwise chain into the surrounding ops at no extra HBM cost.
     xf = x.astype(jnp.float32)
     red_axes = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
     if training and not use_global_stats:
-        mean = jnp.mean(xf, axis=red_axes)
-        var = jnp.var(xf, axis=red_axes)
+        # Single-pass SHIFTED statistics: sums of d and d^2 (d = x - shift)
+        # land in ONE multi-output XLA fusion — one HBM read of the
+        # activations, where jnp.var's two-pass form re-reads the tensor
+        # after the mean is known (and again in its vjp).  BN stats
+        # dominate the non-MXU time of a ResNet step, so this is the hot
+        # spot.  Shifting by the moving mean (free: it fuses into the same
+        # pass) kills the E[x^2]-E[x]^2 catastrophic cancellation once
+        # running stats are warm; f32 accumulation + the clamp guard the
+        # cold start, where the shift is still 0.
+        shift = jnp.asarray(moving_mean).astype(jnp.float32).reshape(shape)
+        d = xf - shift
+        dm = jnp.mean(d, axis=red_axes)
+        d2 = jnp.mean(jnp.square(d), axis=red_axes)
+        var = jnp.maximum(d2 - jnp.square(dm), 0.0)
+        mean = dm + shift.reshape(-1)
     else:
         mean = jnp.asarray(moving_mean).astype(jnp.float32)
         var = jnp.asarray(moving_var).astype(jnp.float32)
-    shape = [1] * x.ndim
-    shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(var + eps).reshape(shape)
-    out = ((xf - mean.reshape(shape)) * inv
-           * g.astype(jnp.float32).reshape(shape)
-           + jnp.asarray(beta).astype(jnp.float32).reshape(shape))
+    # Fold the normalization into one scale+bias per channel so the
+    # per-element chain is a single fused multiply-add.
+    scale = (lax.rsqrt(var + eps) * g.astype(jnp.float32)).reshape(shape)
+    bias = (jnp.asarray(beta).astype(jnp.float32).reshape(shape)
+            - mean.reshape(shape) * scale)
+    out = xf * scale + bias
     return out.astype(x.dtype), mean, var
 
 
 @register("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **_):
     x = jnp.asarray(data)
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    mean, var = _moments(x, (axis % x.ndim,))
+    out = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    return out * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+    out = (out * jnp.asarray(gamma).reshape(shape)
+           + jnp.asarray(beta).reshape(shape))
+    return out.astype(x.dtype)
+
+
+def _moments(x, axes):
+    """Two-pass (mean, var) in f32, keepdims.  Layer/Group/InstanceNorm
+    reduce over small per-sample axes, so the extra read of the two-pass
+    form is cheap — and unlike E[x^2]-E[x]^2 it cannot catastrophically
+    cancel for large-mean activations (residual streams drift).  BatchNorm,
+    whose N*H*W reduction IS the hot path, uses a shifted single-pass in
+    _batch_norm instead.  f32 accumulation also keeps bf16/fp16 inputs from
+    overflowing in jnp.square."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    return mean, var
 
 
 @register("GroupNorm", aliases=("group_norm",))
@@ -189,22 +219,25 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **_):
     n, c = x.shape[0], x.shape[1]
     xg = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
     axes = tuple(range(2, xg.ndim))
-    mean = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.var(xg, axis=axes, keepdims=True)
-    xn = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    mean, var = _moments(xg, axes)
+    xn = ((xg.astype(jnp.float32) - mean)
+          * lax.rsqrt(var + eps)).reshape(x.shape)
     shape = (1, c) + (1,) * (x.ndim - 2)
-    return xn * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+    out = (xn * jnp.asarray(gamma).reshape(shape)
+           + jnp.asarray(beta).reshape(shape))
+    return out.astype(x.dtype)
 
 
 @register("InstanceNorm", aliases=("instance_norm",))
 def _instance_norm(data, gamma, beta, eps=1e-3, **_):
     x = jnp.asarray(data)
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    xn = (x - mean) * lax.rsqrt(var + eps)
+    mean, var = _moments(x, axes)
+    xn = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + eps)
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    return xn * jnp.asarray(gamma).reshape(shape) + jnp.asarray(beta).reshape(shape)
+    out = (xn * jnp.asarray(gamma).reshape(shape)
+           + jnp.asarray(beta).reshape(shape))
+    return out.astype(x.dtype)
 
 
 # ------------------------------------------------------------------ softmax

@@ -169,6 +169,30 @@ def test_quantized_ops_real_int8_jaxpr():
     assert "i8" in jfc and "i32" in jfc, jfc
 
 
+def test_quantized_fc_value_vs_f32():
+    """int8 FC output must track the f32 matmul within the quantization
+    grid: absolute error bounded by ~(amax_d/127 * amax_w/127) per product
+    times sqrt(K) accumulation growth."""
+    from mxnet_tpu.ops.registry import _REGISTRY
+    rng = np.random.RandomState(2)
+    K = 64
+    x = rng.uniform(-2.0, 2.0, (8, K)).astype(np.float32)
+    w = rng.uniform(-2.0, 2.0, (5, K)).astype(np.float32)
+    b = rng.uniform(-1.0, 1.0, (5,)).astype(np.float32)
+    fc_fn = _REGISTRY["_contrib_quantized_fully_connected"].fn
+    out = np.asarray(fc_fn(x, w, b, amax_data=2.0, amax_weight=2.0))
+    ref = x @ w.T + b
+    # Per-term quantization error is bounded by eps_x*|w| + |x|*eps_w with
+    # eps = amax/254 (half a grid step); over K random terms it random-walks
+    # to ~bound*sqrt(K).  3x headroom on top.
+    per_term = (2.0 / 254) * 2.0 + 2.0 * (2.0 / 254)
+    tol = per_term * np.sqrt(K) * 3
+    err = np.abs(out - ref).max()
+    assert err < tol, (err, tol)
+    # and it must not be trivially exact (it IS quantized)
+    assert np.abs(out - ref).max() > 0
+
+
 def test_quantized_conv_block_accuracy_vs_f32():
     """A conv->BN->relu->conv block quantized via quantize_model stays close
     to the f32 model on real data (int8 path, per-tensor symmetric)."""

@@ -113,6 +113,27 @@ def test_batchnorm_running_stats():
     np.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm2_before)
 
 
+def test_norm_large_mean_numerics():
+    """Norm statistics must not catastrophically cancel for offset-heavy
+    activations (mean >> std).  LayerNorm uses two-pass moments; BatchNorm
+    uses a shifted single-pass — both must recover unit output std."""
+    from mxnet_tpu.ops.nn import _batch_norm, _layer_norm
+    rng = np.random.RandomState(0)
+    x = (4096.0 + 0.5 * rng.randn(16, 64)).astype(np.float32)
+    out = np.asarray(_layer_norm(x, np.ones(64, 'f'), np.zeros(64, 'f')))
+    assert abs(out.std() - 1.0) < 0.05, out.std()
+
+    # BatchNorm with WARM moving stats (the shift): exact variance recovery
+    xb = (4096.0 + 0.5 * rng.randn(64, 4, 8, 8)).astype(np.float32)
+    mm = np.full(4, 4096.0, 'f')
+    o, m, v = _batch_norm(xb, np.ones(4, 'f'), np.zeros(4, 'f'), mm,
+                          np.ones(4, 'f'), eps=1e-5, fix_gamma=False,
+                          training=True)
+    ref_v = xb.reshape(64, 4, -1).transpose(1, 0, 2).reshape(4, -1).var(1)
+    np.testing.assert_allclose(np.asarray(v), ref_v, rtol=0.05)
+    assert abs(np.asarray(o).std() - 1.0) < 0.05
+
+
 def test_conv_layers():
     x = mx.nd.random.uniform(shape=(2, 3, 10, 10))
     conv = nn.Conv2D(6, 3, padding=1)

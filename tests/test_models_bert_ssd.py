@@ -148,7 +148,8 @@ def test_multibox_target_semantics():
     labels[0, 0] = [2, 0.05, 0.05, 0.35, 0.35]
     cls_pred = np.random.RandomState(0).rand(1, 4, N).astype(np.float32)
     bt, bm, ct = mx.nd.MultiBoxTarget(anchors, mx.nd.array(labels),
-                                      mx.nd.array(cls_pred))
+                                      mx.nd.array(cls_pred),
+                                      negative_mining_ratio=3.0)
     ct_host = ct.asnumpy()[0]
     # at least one anchor matched to class 2 -> target 3 (cls+1)
     assert (ct_host == 3.0).sum() >= 1
@@ -156,3 +157,26 @@ def test_multibox_target_semantics():
     assert (ct_host == 0.0).sum() >= 1
     # matched anchors have unit box mask
     assert bm.asnumpy()[0].reshape(N, 4)[ct_host == 3.0].min() == 1.0
+
+
+def test_multibox_target_greedy_match_shared_anchor():
+    """Two gt boxes whose best anchor is the SAME anchor must both get a
+    forced match (greedy bipartite, like multibox_target.cc) — a per-gt
+    argmax scatter would silently drop one object."""
+    # one anchor near both gts, others far away
+    anchors = mx.nd.array(np.array(
+        [[[0.4, 0.4, 0.6, 0.6],      # best anchor for BOTH gts
+          [0.41, 0.41, 0.61, 0.61],  # runner-up
+          [0.0, 0.0, 0.05, 0.05],
+          [0.9, 0.9, 1.0, 1.0]]], np.float32))
+    labels = np.array([[[0, 0.38, 0.38, 0.58, 0.58],
+                        [1, 0.42, 0.42, 0.62, 0.62]]], np.float32)
+    cls_pred = np.zeros((1, 3, 4), np.float32)
+    # high threshold so only forced matches count
+    bt, bm, ct = mx.nd.MultiBoxTarget(anchors, mx.nd.array(labels),
+                                      mx.nd.array(cls_pred),
+                                      overlap_threshold=0.99)
+    ct_host = ct.asnumpy()[0]
+    # both classes present: each gt claimed its own anchor
+    assert (ct_host == 1.0).sum() == 1, ct_host   # class 0 -> target 1
+    assert (ct_host == 2.0).sum() == 1, ct_host   # class 1 -> target 2
