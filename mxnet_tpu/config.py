@@ -131,6 +131,12 @@ register_knob(
     "xla.autotune", "MXNET_CUDNN_AUTOTUNE_DEFAULT", int, 0,
     "cuDNN autotune (env_var.md:234) maps to XLA's internal autotuning; "
     "value is informational.")
+register_knob(
+    "bn_two_pass_stats", "MXTPU_BN_TWO_PASS_STATS", bool, False,
+    "BatchNorm training statistics: False (default) = single-pass "
+    "moving-mean-shifted moments (one HBM read, the fast path); True = "
+    "exact two-pass jnp.var for offset-heavy inputs whose |mean|/std "
+    "exceeds ~3000 at cold start.")
 
 
 def _autostart():

@@ -160,21 +160,35 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     if training and not use_global_stats:
-        # Single-pass SHIFTED statistics: sums of d and d^2 (d = x - shift)
-        # land in ONE multi-output XLA fusion — one HBM read of the
-        # activations, where jnp.var's two-pass form re-reads the tensor
-        # after the mean is known (and again in its vjp).  BN stats
-        # dominate the non-MXU time of a ResNet step, so this is the hot
-        # spot.  Shifting by the moving mean (free: it fuses into the same
-        # pass) kills the E[x^2]-E[x]^2 catastrophic cancellation once
-        # running stats are warm; f32 accumulation + the clamp guard the
-        # cold start, where the shift is still 0.
-        shift = jnp.asarray(moving_mean).astype(jnp.float32).reshape(shape)
-        d = xf - shift
-        dm = jnp.mean(d, axis=red_axes)
-        d2 = jnp.mean(jnp.square(d), axis=red_axes)
-        var = jnp.maximum(d2 - jnp.square(dm), 0.0)
-        mean = dm + shift.reshape(-1)
+        from .. import config as _config
+        if _config.get("bn_two_pass_stats"):
+            # exact two-pass variance for pathological offset-heavy inputs
+            # (mean/std ratio beyond ~3000 at cold start) — costs an extra
+            # HBM read of the activations per step
+            mean = jnp.mean(xf, axis=red_axes)
+            var = jnp.var(xf, axis=red_axes)
+        else:
+            # Single-pass SHIFTED statistics: sums of d and d^2
+            # (d = x - shift) land in ONE multi-output XLA fusion — one HBM
+            # read of the activations, where jnp.var's two-pass form
+            # re-reads the tensor after the mean is known (and again in its
+            # vjp).  BN stats dominate the non-MXU time of a ResNet step,
+            # so this is the hot spot.  The shift is the moving mean: free
+            # (fuses into the same pass; a data-derived proxy was measured
+            # to break producer fusion, +20% step time) and it tracks the
+            # batch mean from step 2 on, so E[d^2]-E[d]^2 cancellation
+            # cannot ignite once stats are warm.  The exposure is step 1
+            # with |mean|/std beyond ~3000 (f32 accumulation absorbs
+            # anything smaller); conv outputs under zero-mean init are
+            # nowhere near that, and `mx.config.set("bn_two_pass_stats",
+            # True)` selects the exact path for data that is.
+            shift = jnp.asarray(moving_mean).astype(jnp.float32)\
+                .reshape(shape)
+            d = xf - shift
+            dm = jnp.mean(d, axis=red_axes)
+            d2 = jnp.mean(jnp.square(d), axis=red_axes)
+            var = jnp.maximum(d2 - jnp.square(dm), 0.0)
+            mean = dm + shift.reshape(-1)
     else:
         mean = jnp.asarray(moving_mean).astype(jnp.float32)
         var = jnp.asarray(moving_var).astype(jnp.float32)

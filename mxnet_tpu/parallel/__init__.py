@@ -22,13 +22,18 @@ from .ring_attention import ring_attention, attention, \
     ring_self_attention_sharded
 from .functional import functionalize, BlockFunction
 from .trainer import SPMDTrainer, build_train_step
+from .pipeline import (pipeline_apply, pipeline_sharded, microbatch,
+                       unmicrobatch)
+from .moe import moe_ffn, moe_ffn_sharded, top_k_routing
 
 __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "shard_batch", "replicated", "Mesh", "NamedSharding",
            "PartitionSpec", "ring_attention", "attention",
            "ring_self_attention_sharded", "functionalize", "BlockFunction",
            "SPMDTrainer", "build_train_step", "host_allreduce",
-           "initialize", "ensure_initialized", "barrier"]
+           "initialize", "ensure_initialized", "barrier",
+           "pipeline_apply", "pipeline_sharded", "microbatch",
+           "unmicrobatch", "moe_ffn", "moe_ffn_sharded", "top_k_routing"]
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):

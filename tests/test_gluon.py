@@ -123,15 +123,34 @@ def test_norm_large_mean_numerics():
     out = np.asarray(_layer_norm(x, np.ones(64, 'f'), np.zeros(64, 'f')))
     assert abs(out.std() - 1.0) < 0.05, out.std()
 
-    # BatchNorm with WARM moving stats (the shift): exact variance recovery
+    # BatchNorm fast path: warm moving stats recover an extreme offset
+    # exactly; a cold start must hold up to the documented |mean|/std bound
     xb = (4096.0 + 0.5 * rng.randn(64, 4, 8, 8)).astype(np.float32)
-    mm = np.full(4, 4096.0, 'f')
-    o, m, v = _batch_norm(xb, np.ones(4, 'f'), np.zeros(4, 'f'), mm,
-                          np.ones(4, 'f'), eps=1e-5, fix_gamma=False,
-                          training=True)
     ref_v = xb.reshape(64, 4, -1).transpose(1, 0, 2).reshape(4, -1).var(1)
+    o, m, v = _batch_norm(xb, np.ones(4, 'f'), np.zeros(4, 'f'),
+                          np.full(4, 4096.0, 'f'), np.ones(4, 'f'),
+                          eps=1e-5, fix_gamma=False, training=True)
     np.testing.assert_allclose(np.asarray(v), ref_v, rtol=0.05)
     assert abs(np.asarray(o).std() - 1.0) < 0.05
+
+    xc = (100.0 + 0.5 * rng.randn(64, 4, 8, 8)).astype(np.float32)
+    ref_vc = xc.reshape(64, 4, -1).transpose(1, 0, 2).reshape(4, -1).var(1)
+    o, m, v = _batch_norm(xc, np.ones(4, 'f'), np.zeros(4, 'f'),
+                          np.zeros(4, 'f'), np.ones(4, 'f'), eps=1e-5,
+                          fix_gamma=False, training=True)
+    np.testing.assert_allclose(np.asarray(v), ref_vc, rtol=0.05)
+
+    # beyond the bound, the bn_two_pass_stats knob selects the exact path
+    from mxnet_tpu import config as mxconfig
+    mxconfig.set("bn_two_pass_stats", True)
+    try:
+        o, m, v = _batch_norm(xb, np.ones(4, 'f'), np.zeros(4, 'f'),
+                              np.zeros(4, 'f'), np.ones(4, 'f'), eps=1e-5,
+                              fix_gamma=False, training=True)
+        np.testing.assert_allclose(np.asarray(v), ref_v, rtol=0.05)
+        assert abs(np.asarray(o).std() - 1.0) < 0.05
+    finally:
+        mxconfig.set("bn_two_pass_stats", False)
 
 
 def test_conv_layers():
