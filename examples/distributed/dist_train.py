@@ -1,0 +1,68 @@
+"""Multi-process data-parallel training with the dist_sync kvstore.
+
+Reference analog: distributed training via ps-lite
+(docs distributed_training.md; tests/nightly/dist_lenet.py), launched as N
+local processes the way the reference CI does
+(ci/docker/runtime_functions.sh:1366: tools/launch.py -n N --launcher
+local ...).  Here the parameter server is replaced by jax.distributed
+rendezvous + DCN-analog host allreduce behind the same kvstore facade.
+
+Run:
+    python tools/launch.py -n 2 --launcher local \
+        python examples/distributed/dist_train.py
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "../..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def main():
+    kv = mx.kv.create("dist_sync")  # bootstraps rendezvous from launcher env
+    rank, nworker = kv.rank, kv.num_workers
+    print("worker %d/%d up" % (rank, nworker), flush=True)
+
+    # each worker sees its own shard of the synthetic dataset
+    rng = np.random.RandomState(100 + rank)
+    n_local = 512
+    w_true = np.array([[2.0], [-3.0], [0.5]], np.float32)
+    X = rng.normal(size=(n_local, 3)).astype(np.float32)
+    Y = X @ w_true + 0.01 * rng.normal(size=(n_local, 1)).astype(np.float32)
+
+    net = gluon.nn.Dense(1, in_units=3)
+    net.initialize(mx.init.Zero())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    loss_fn = gluon.loss.L2Loss()
+
+    bs = 64
+    for epoch in range(5):
+        perm = rng.permutation(n_local)
+        total = 0.0
+        for i in range(0, n_local, bs):
+            xb = mx.nd.array(X[perm[i:i + bs]])
+            yb = mx.nd.array(Y[perm[i:i + bs]])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)   # grads allreduced across workers via kvstore
+            total += float(loss.asnumpy())
+        if rank == 0:
+            print("epoch %d: loss %.6f" % (epoch, total / (n_local // bs)),
+                  flush=True)
+
+    w = net.weight.data().asnumpy().ravel()
+    err = np.abs(w - w_true.ravel()).max()
+    assert err < 0.05, "worker %d: weights off by %.4f" % (rank, err)
+    print("WORKER_OK rank=%d w=%s" % (rank, np.round(w, 3)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

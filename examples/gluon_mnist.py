@@ -1,0 +1,95 @@
+"""LeNet on MNIST via Gluon — BASELINE config 1.
+
+Reference analog: example/gluon/mnist/mnist.py (Gluon net + autograd record
++ Trainer step + metric).  Runs on synthetic MNIST-shaped data by default;
+pass --data-dir with the MNIST idx files to train on the real set via
+mx.io.MNISTIter.
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, 5), nn.MaxPool2D(2, 2), nn.Activation("tanh"),
+            nn.Conv2D(50, 5), nn.MaxPool2D(2, 2), nn.Activation("tanh"),
+            nn.Flatten(), nn.Dense(500, activation="tanh"), nn.Dense(10))
+    return net
+
+
+def synthetic_mnist(n, seed=0):
+    """Class-separable synthetic digits: class k lights a kth stripe."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.uniform(0, 0.2, (n, 1, 28, 28)).astype(np.float32)
+    for i, k in enumerate(y):
+        x[i, 0, 2 * k:2 * k + 3, :] += 0.8
+    return x, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--data-dir", default=None,
+                    help="dir with MNIST idx files; synthetic when unset")
+    ap.add_argument("--samples", type=int, default=2048,
+                    help="synthetic train-set size")
+    args = ap.parse_args()
+
+    if args.data_dir:
+        train_iter = mx.io.MNISTIter(
+            image="%s/train-images-idx3-ubyte" % args.data_dir,
+            label="%s/train-labels-idx1-ubyte" % args.data_dir,
+            batch_size=args.batch_size, shuffle=True)
+    else:
+        X, Y = synthetic_mnist(args.samples)
+        train_iter = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                                       shuffle=True)
+
+    net = build_lenet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        train_iter.reset()
+        tic = time.time()
+        n = 0
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label).mean()
+            loss.backward()
+            trainer.step(1)
+            metric.update([label], [out])
+            n += data.shape[0]
+        name, acc = metric.get()
+        print("epoch %d: %s=%.4f (%.0f samples/s)"
+              % (epoch, name, acc, n / (time.time() - tic)))
+
+    net.export("lenet")  # symbol-json + params deployment pair
+    print("exported lenet-symbol.json / lenet-0000.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""SSD object detection training — BASELINE config 4.
+
+Reference analog: example/ssd/train.py (MultiBoxPrior anchors +
+MultiBoxTarget assignment + softmax/smooth-L1 losses + MultiBoxDetection
+NMS at inference).  Synthetic boxes by default; pass --data-rec with an
+ImageDetRecordIter .rec for real data.
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.models.ssd import ssd_512, MultiBoxLoss
+
+
+def synthetic_batch(rng, B, size, num_classes, max_boxes=4):
+    """Images with colored rectangles; labels [cls, x1, y1, x2, y2]."""
+    x = rng.uniform(0, 0.3, (B, 3, size, size)).astype(np.float32)
+    labels = np.full((B, max_boxes, 5), -1.0, np.float32)
+    for b in range(B):
+        for k in range(rng.randint(1, max_boxes + 1)):
+            cls = rng.randint(0, num_classes)
+            cx, cy = rng.uniform(0.2, 0.8, 2)
+            w, h = rng.uniform(0.1, 0.3, 2)
+            x1, y1 = max(cx - w / 2, 0.0), max(cy - h / 2, 0.0)
+            x2, y2 = min(cx + w / 2, 1.0), min(cy + h / 2, 1.0)
+            px = slice(int(x1 * size), max(int(x2 * size), int(x1 * size) + 1))
+            py = slice(int(y1 * size), max(int(y2 * size), int(y1 * size) + 1))
+            x[b, cls % 3, py, px] = 1.0
+            labels[b, k] = [cls, x1, y1, x2, y2]
+    return x, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--data-rec", default=None,
+                    help="ImageDetRecordIter .rec; synthetic when unset")
+    args = ap.parse_args()
+
+    net = ssd_512(num_classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    loss_fn = MultiBoxLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+
+    def batches():
+        if args.data_rec:
+            it = mx.io.ImageDetRecordIter(
+                path_imgrec=args.data_rec, batch_size=args.batch_size,
+                data_shape=(3, args.size, args.size))
+            for b in it:
+                yield b.data[0], b.label[0]
+        else:
+            while True:
+                x, lab = synthetic_batch(rng, args.batch_size, args.size,
+                                         args.num_classes)
+                yield mx.nd.array(x), mx.nd.array(lab)
+
+    tic = time.time()
+    for i, (x, labels) in enumerate(batches()):
+        if i >= args.steps:
+            break
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            with autograd.pause():
+                bt, bm, ct = net.targets(anchors, cls_preds, labels)
+            loss = loss_fn(cls_preds, box_preds, ct, bt, bm)
+        loss.backward()
+        trainer.step(1)
+        if (i + 1) % 5 == 0:
+            print("step %d: loss %.4f" % (i + 1, float(loss.asnumpy())))
+    print("%.2f img/s" % (args.batch_size * args.steps /
+                          (time.time() - tic)))
+
+    # inference path: decode + per-class NMS (MultiBoxDetection)
+    x, _ = synthetic_batch(rng, 2, args.size, args.num_classes)
+    anchors, cls_preds, box_preds = net(mx.nd.array(x))
+    det = net.detect(anchors, cls_preds, box_preds)
+    kept = int((det.asnumpy()[:, :, 0] >= 0).sum())
+    print("detections kept after NMS: %d" % kept)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""ResNet-50 ImageNet training — BASELINE config 2.
+
+Reference analog: example/image-classification/train_imagenet.py.  The
+``--benchmark 1`` mode reproduces its synthetic-data throughput measurement
+(the BASELINE.md 363.69 img/s V100 number was measured this way); real
+training reads an ImageRecordIter .rec file.  The reference's
+kvstore='device' gradient allreduce is the mesh 'dp' axis here: the
+SPMDTrainer step is one jitted program and XLA schedules the psum over ICI.
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet50_v1")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--benchmark", type=int, default=0,
+                    help="1 = synthetic-data throughput mode")
+    ap.add_argument("--num-iters", type=int, default=50)
+    ap.add_argument("--num-devices", type=int, default=-1,
+                    help="dp mesh size; -1 = all visible devices")
+    ap.add_argument("--data-train", default=None, help=".rec file")
+    ap.add_argument("--epochs", type=int, default=1)
+    args = ap.parse_args()
+
+    shape = tuple(int(s) for s in args.image_shape.split(","))
+    mesh = make_mesh({"dp": args.num_devices})
+
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.zeros((2,) + shape, np.float32)))  # deferred shapes
+
+    trainer = SPMDTrainer(
+        net, SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4},
+        mesh=mesh, dtype=None if args.dtype == "float32" else args.dtype)
+
+    if args.benchmark:
+        rng = np.random.RandomState(0)
+        data = rng.uniform(size=(args.batch_size,) + shape)\
+            .astype(np.float32)
+        label = rng.randint(0, args.num_classes,
+                            (args.batch_size,)).astype(np.float32)
+        loss = trainer.step(data, label)       # compile + transfer
+        np.asarray(loss)
+        tic = time.time()
+        for _ in range(args.num_iters):
+            loss = trainer.step(data, label)
+        np.asarray(loss)
+        dt = time.time() - tic
+        print("%s %s BS%d: %.2f img/s"
+              % (args.network, args.dtype, args.batch_size,
+                 args.batch_size * args.num_iters / dt))
+        return
+
+    if not args.data_train:
+        ap.error("--data-train required unless --benchmark 1")
+    for epoch in range(args.epochs):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, batch_size=args.batch_size,
+            data_shape=shape, shuffle=True)
+        n, tic = 0, time.time()
+        for batch in it:
+            loss = trainer.step(batch.data[0], batch.label[0])
+            n += args.batch_size
+        print("epoch %d: loss %.4f, %.0f img/s"
+              % (epoch, float(np.asarray(loss)), n / (time.time() - tic)))
+        trainer.save_checkpoint("%s-%04d.ckpt" % (args.network, epoch))
+
+
+if __name__ == "__main__":
+    main()

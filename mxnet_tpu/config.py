@@ -59,6 +59,18 @@ def set(name, value):  # noqa: A001 — reference-parity name
     # set('x', '0') and ENV_X=0 agree (notably for bools)
     _OVERRIDES[name] = _parse(knob, value) if isinstance(value, str) \
         else knob.type(value)
+    global _EPOCH
+    _EPOCH += 1
+
+
+# Bumped by every set(): compiled-program caches that bake knob values in at
+# trace time (Executor forward programs, _CachedGraph) key on epoch() so a
+# knob change invalidates them instead of silently not applying.
+_EPOCH = 0
+
+
+def epoch():
+    return _EPOCH
 
 
 def knobs():

@@ -534,10 +534,12 @@ class _CachedGraph:
         return jitted
 
     def __call__(self, *args):
+        from .. import config as _config
         training = autograd.is_training()
-        if training not in self._jitted:
-            self._jitted[training] = self._build(training)
-        fn = self._jitted[training]
+        key = (training, _config.epoch())  # knob values bake in at trace
+        if key not in self._jitted:
+            self._jitted[key] = self._build(training)
+        fn = self._jitted[key]
         self._ensure_params()
         params = self.params
 
@@ -676,8 +678,17 @@ class HybridBlock(Block):
         return self.hybrid_forward(nd_module, *args, **params)
 
     def forward(self, x, *args):
-        """Defines the forward computation: dispatches to cached (jit) or
-        eager execution (reference: block.py:1146)."""
+        """Defines the forward computation: dispatches to symbolic trace,
+        cached (jit), or eager execution (reference: block.py:1146)."""
+        from ..symbol import Symbol as _Symbol
+        if isinstance(x, _Symbol):
+            # symbolic trace (export path): parameters enter the graph as
+            # named free Variables so the saved JSON's input names match
+            # the param-file keys (reference block.py:1077 export contract)
+            from .. import symbol as sym_module
+            kwargs = {name: sym_module.var(p.name)
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_module, x, *args, **kwargs)
         if self._active and not _TRACE_GUARD.active:
             if self._cached_graph_obj is None:
                 # first call runs eagerly to resolve all deferred shapes,
@@ -688,11 +699,14 @@ class HybridBlock(Block):
             return self._cached_graph_obj(x, *args)
         return self._eager_forward(x, *args)
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
+    def export(self, path, epoch=0, remove_amp_cast=True,
+               input_names=("data",)):
         """Export graph JSON + params for deployment
-        (reference: block.py:1077) — see mxnet_tpu.symbol for the format."""
+        (reference: block.py:1077) — see mxnet_tpu.symbol for the format.
+        Multi-input blocks name their inputs via ``input_names``."""
         from ..symbol import _export_hybrid_block
-        return _export_hybrid_block(self, path, epoch)
+        return _export_hybrid_block(self, path, epoch,
+                                    input_names=input_names)
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """Partial parity: on TPU the backend compiler is always XLA; this
@@ -763,7 +777,11 @@ class SymbolBlock(HybridBlock):
                 param_vals[name] = p.data()
         bindings = dict(inputs)
         bindings.update(param_vals)
-        out = self._output_sym.eval_dict(bindings)
+        # honor the autograd mode: under record/train_mode the graph must
+        # run its training semantics (Dropout active, BatchNorm batch
+        # stats) — Symbol.eval would silently pin is_train=False
+        ex = self._output_sym.bind(None, args=bindings)
+        out = ex.forward(is_train=autograd.is_training())
         if isinstance(out, (list, tuple)) and len(out) == 1:
             return out[0]
         return out

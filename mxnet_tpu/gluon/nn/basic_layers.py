@@ -229,6 +229,11 @@ class BatchNorm(HybridBlock):
         training = autograd.is_training()
         out = F.BatchNorm(x, gamma, beta, running_mean, running_var,
                           name="fwd", training=training, **self._kwargs)
+        if not isinstance(out, (tuple, list)):
+            # symbolic trace: BatchNorm exposes ONE output (reference UX —
+            # moving stats are aux states, written back by the executor's
+            # _bn_aux_update rule, symbol/symbol.py)
+            return out
         out, batch_mean, batch_var = out
         if training and not self._kwargs["use_global_stats"]:
             m = self._momentum

@@ -30,7 +30,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_raw
 
 __all__ = ["pipeline_apply", "pipeline_sharded", "microbatch",
-           "unmicrobatch"]
+           "unmicrobatch", "shmap"]
 
 
 import inspect as _inspect

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from .pipeline import shmap
 
 __all__ = ["ring_attention", "attention", "ring_self_attention_sharded"]
 
@@ -75,6 +76,13 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     o0 = jnp.zeros(acc_shape, jnp.float32)
     m0 = jnp.full(q.shape[:-1] + (1,), _NEG, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+    if hasattr(lax, "pcast"):
+        # jax>=0.8 varying-manual-axes typing: the accumulators start
+        # replicated but turn axis-varying inside the ring loop
+        vma = tuple(getattr(jax.typeof(q), "vma", ()) or ()) or (axis_name,)
+        vma = tuple(set(vma) | {axis_name})
+        o0, m0, l0 = (lax.pcast(t, vma, to="varying")
+                      for t in (o0, m0, l0))
 
     def body(step, carry):
         o, m, l, kb, vb = carry
@@ -106,6 +114,4 @@ def ring_self_attention_sharded(mesh, q, k, v, causal=False,
     axes are sharded (batch->'dp', heads->'tp', seq->'sp') on `mesh`."""
     spec = P(batch_axis, head_axis, seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    shmapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_rep=False)
-    return shmapped(q, k, v)
+    return shmap(fn, mesh, (spec, spec, spec), spec)(q, k, v)

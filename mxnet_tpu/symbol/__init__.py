@@ -18,6 +18,22 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 from ..ops import registry as _registry
 
 
+def _export_hybrid_block(block, path, epoch=0, input_names=("data",)):
+    """HybridBlock.export backend: trace the block into a Symbol graph and
+    write the reference deployment pair ``path-symbol.json`` +
+    ``path-%04d.params`` (arg:/aux: packing, python/mxnet/gluon/block.py:1077
+    + model.py:394) — reloadable with ``SymbolBlock.imports``."""
+    from .. import model as _model
+    out = block(*[Variable(n) for n in input_names])
+    if isinstance(out, (list, tuple)):
+        out = Group(list(out))
+    arg, aux = {}, {}
+    for name, p in block.collect_params().items():
+        (aux if p.grad_req == "null" else arg)[name] = p.data()
+    _model.save_checkpoint(path, epoch, out, arg, aux)
+    return ["%s-symbol.json" % path, "%s-%04d.params" % (path, epoch)]
+
+
 def __getattr__(name):
     try:
         _registry.get(name)

@@ -731,7 +731,9 @@ class Executor:
         return env
 
     def _fwd_fn(self, training):
-        if training not in self._fwd_cache:
+        from .. import config as _config
+        cache_key = (training, _config.epoch())  # knobs bake in at trace
+        if cache_key not in self._fwd_cache:
             sym = self._symbol
 
             def run(env, key):
@@ -740,8 +742,8 @@ class Executor:
                     outs = _eval_symbol(sym, env, training, aux_updates)
                     return outs, aux_updates
 
-            self._fwd_cache[training] = jax.jit(run)
-        return self._fwd_cache[training]
+            self._fwd_cache[cache_key] = jax.jit(run)
+        return self._fwd_cache[cache_key]
 
     # public --------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):

@@ -138,3 +138,29 @@ def test_variable_shape_attr_infer():
     out = sym.FullyConnected(data, num_hidden=2, name="fc")
     arg_shapes, out_shapes, _ = out.infer_shape()
     assert out_shapes == [(4, 2)]
+
+
+def test_hybrid_block_export_imports_roundtrip(tmp_path):
+    """HybridBlock.export writes the reference deployment pair
+    (prefix-symbol.json + prefix-0000.params, gluon/block.py:1077) and
+    SymbolBlock.imports reloads it with identical inference outputs —
+    including BatchNorm, whose symbolic form has ONE output with moving
+    stats as executor-managed aux states."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.Flatten(), nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+
+    prefix = str(tmp_path / "exported")
+    net.export(prefix)
+    assert (tmp_path / "exported-symbol.json").exists()
+    assert (tmp_path / "exported-0000.params").exists()
+
+    re = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    np.testing.assert_allclose(re(x).asnumpy(), ref, rtol=1e-5, atol=1e-6)
