@@ -79,12 +79,16 @@ def main():
         it = mx.io.ImageRecordIter(
             path_imgrec=args.data_train, batch_size=args.batch_size,
             data_shape=shape, shuffle=True)
-        n, tic = 0, time.time()
+        n, total, tic = 0, 0.0, time.time()
         for batch in it:
             loss = trainer.step(batch.data[0], batch.label[0])
+            total += float(np.asarray(loss))
             n += args.batch_size
-        print("epoch %d: loss %.4f, %.0f img/s"
-              % (epoch, float(np.asarray(loss)), n / (time.time() - tic)))
+        if n == 0:
+            raise RuntimeError("no batches read from %r" % args.data_train)
+        print("epoch %d: mean loss %.4f, %.0f img/s"
+              % (epoch, total / (n / args.batch_size),
+                 n / (time.time() - tic)))
         trainer.save_checkpoint("%s-%04d.ckpt" % (args.network, epoch))
 
 

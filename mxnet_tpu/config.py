@@ -57,8 +57,11 @@ def set(name, value):  # noqa: A001 — reference-parity name
     knob = _KNOBS[name]
     # strings coerce through the same parser as env vars, so
     # set('x', '0') and ENV_X=0 agree (notably for bools)
-    _OVERRIDES[name] = _parse(knob, value) if isinstance(value, str) \
+    parsed = _parse(knob, value) if isinstance(value, str) \
         else knob.type(value)
+    if name in _OVERRIDES and _OVERRIDES[name] == parsed:
+        return  # no-op set: don't invalidate compiled-program caches
+    _OVERRIDES[name] = parsed
     global _EPOCH
     _EPOCH += 1
 

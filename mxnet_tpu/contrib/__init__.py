@@ -14,6 +14,13 @@ from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from . import text  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import onnx  # noqa: F401  (gated: StableHLO is the TPU interchange)
+from . import tensorboard  # noqa: F401  (gated SummaryWriter hook)
+from . import tensorrt  # noqa: F401  (documented: XLA is the engine)
+from . import ndarray  # noqa: F401  (contrib op namespace alias)
+from . import symbol  # noqa: F401  (contrib op namespace alias)
+from . import io  # noqa: F401  (DataLoaderIter)
+from . import autograd  # noqa: F401  (deprecated forwarding module)
 
 __all__ = ["quantization", "amp", "foreach", "while_loop", "cond", "text",
-           "svrg_optimization", "onnx"]
+           "svrg_optimization", "onnx", "tensorboard", "tensorrt",
+           "ndarray", "symbol", "io", "autograd"]

@@ -1,0 +1,5 @@
+"""mx.contrib.autograd — the reference keeps a deprecated contrib autograd
+module forwarding to mxnet.autograd (python/mxnet/contrib/autograd.py);
+same here."""
+from ..autograd import *  # noqa: F401,F403
+from ..autograd import record, pause, is_training, is_recording  # noqa: F401

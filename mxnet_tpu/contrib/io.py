@@ -1,0 +1,47 @@
+"""mx.contrib.io (reference: python/mxnet/contrib/io.py DataLoaderIter —
+wraps a gluon DataLoader as a classic mx.io DataIter)."""
+from __future__ import annotations
+
+from ..io import DataBatch
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter:
+    """Adapts ``gluon.data.DataLoader`` to the DataIter protocol so Module
+    fit loops can consume Gluon datasets."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        from ..io import DataDesc
+        self._loader = loader
+        self.data_name = data_name
+        self.label_name = label_name
+        # Module.bind reads provide_data/provide_label (DataDesc protocol,
+        # module/base_module.py) — peek one batch from a THROWAWAY iterator
+        # for the shapes, then start clean
+        first = next(iter(loader), None)
+        if first is None:
+            self.provide_data, self.provide_label = [], []
+        else:
+            d = first[0] if isinstance(first, (list, tuple)) else first
+            self.provide_data = [DataDesc(data_name, tuple(d.shape))]
+            self.provide_label = (
+                [DataDesc(label_name, tuple(first[1].shape))]
+                if isinstance(first, (list, tuple)) and len(first) > 1
+                else [])
+        self._iter = iter(loader)
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._iter)
+        data, label = (batch[0], batch[1]) if isinstance(
+            batch, (list, tuple)) else (batch, None)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else [])
+
+    next = __next__

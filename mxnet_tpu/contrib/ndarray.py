@@ -1,0 +1,2 @@
+"""mx.contrib.ndarray — alias of nd.contrib (reference keeps both paths)."""
+from ..ndarray.contrib import __getattr__  # noqa: F401

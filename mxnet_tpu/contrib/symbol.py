@@ -1,0 +1,2 @@
+"""mx.contrib.symbol — alias of sym.contrib (reference keeps both paths)."""
+from ..symbol.contrib import __getattr__  # noqa: F401

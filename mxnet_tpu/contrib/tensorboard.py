@@ -1,0 +1,46 @@
+"""TensorBoard logging hook (reference: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback over the `tensorboard` SummaryWriter).
+
+Gated: the heavyweight SummaryWriter dependency is optional.  Without it
+the callback degrades to buffering scalars in memory (inspectable via
+`.history`), so training scripts keep running in the zero-egress image.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch/epoch callback that logs eval metrics as TB scalars."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.history = {}
+        try:
+            from tensorboardX import SummaryWriter  # optional
+            self._writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer = SummaryWriter(logging_dir)
+            except Exception:  # noqa: BLE001 — no TB backend present
+                self._writer = None
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in zip(*_as_lists(param.eval_metric.get())):
+            if self.prefix:
+                name = "%s-%s" % (self.prefix, name)
+            self.history.setdefault(name, []).append(float(value))
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self._step)
+
+
+def _as_lists(nv):
+    name, value = nv
+    if isinstance(name, str):
+        return [name], [value]
+    return list(name), list(value)

@@ -1,0 +1,28 @@
+"""TensorRT integration point (reference: python/mxnet/contrib/tensorrt.py).
+
+No TPU counterpart exists BY DESIGN: TensorRT is an NVIDIA inference
+engine; on TPU the inference engine is XLA itself, and the deployment
+artifact is serialized StableHLO (see mxnet_tpu.deploy.export_model — the
+analog of the reference's trt graph conversion + c_predict_api).  The
+reference entry points raise with that redirection instead of silently
+doing nothing.
+"""
+from __future__ import annotations
+
+__all__ = ["init_tensorrt_params", "tensorrt_bind", "set_use_fp16"]
+
+_MSG = ("TensorRT has no TPU counterpart; XLA is the inference engine. "
+        "Use mxnet_tpu.deploy.export_model / load_model (StableHLO) for "
+        "deployment, and mx.amp for reduced-precision inference.")
+
+
+def tensorrt_bind(*_a, **_k):
+    raise NotImplementedError(_MSG)
+
+
+def init_tensorrt_params(*_a, **_k):
+    raise NotImplementedError(_MSG)
+
+
+def set_use_fp16(*_a, **_k):
+    raise NotImplementedError(_MSG)

@@ -538,6 +538,9 @@ class _CachedGraph:
         training = autograd.is_training()
         key = (training, _config.epoch())  # knob values bake in at trace
         if key not in self._jitted:
+            # evict programs compiled under superseded knob epochs
+            self._jitted = {k: v for k, v in self._jitted.items()
+                            if k[1] == key[1]}
             self._jitted[key] = self._build(training)
         fn = self._jitted[key]
         self._ensure_params()
@@ -757,7 +760,9 @@ class SymbolBlock(HybridBlock):
         self._input_syms = list(inputs)
         self._input_names = [i.name for i in self._input_syms]
 
-        # register every non-input free variable as a parameter
+        # register every non-input free variable as a parameter; moving
+        # stats are aux (non-trainable), classified like the symbol layer
+        from ..symbol.symbol import _is_aux_name
         arg_names = outputs.list_inputs()
         existing = dict(params.items()) if params is not None else {}
         for name in arg_names:
@@ -767,21 +772,42 @@ class SymbolBlock(HybridBlock):
                 self.params._params[name] = existing[name]
             else:
                 self.params._params[name] = Parameter(
-                    name, shape=None, allow_deferred_init=True)
+                    name, shape=None, allow_deferred_init=True,
+                    grad_req="null" if _is_aux_name(name) else "write")
+        self._executor = None
 
     def forward(self, x, *args):
+        from ..symbol.symbol import _is_aux_name
         inputs = dict(zip(self._input_names, (x,) + args))
-        param_vals = {}
+        arg_vals, aux_vals = {}, {}
         for name, p in self.params.items():
-            if name not in self._input_names:
-                param_vals[name] = p.data()
-        bindings = dict(inputs)
-        bindings.update(param_vals)
-        # honor the autograd mode: under record/train_mode the graph must
-        # run its training semantics (Dropout active, BatchNorm batch
-        # stats) — Symbol.eval would silently pin is_train=False
-        ex = self._output_sym.bind(None, args=bindings)
-        out = ex.forward(is_train=autograd.is_training())
+            if name in self._input_names:
+                continue
+            (aux_vals if _is_aux_name(name) else arg_vals)[name] = p.data()
+        if self._executor is None:
+            # ONE bound executor for the block's lifetime: its internal
+            # (training, config-epoch)-keyed jit cache makes repeat calls
+            # cached dispatch instead of a retrace per call
+            bindings = dict(inputs)
+            bindings.update(arg_vals)
+            self._executor = self._output_sym.bind(
+                None, args=bindings, aux_states=aux_vals, grad_req="null")
+        ex = self._executor
+        # refresh aux values (args/inputs refresh through forward(**kwargs))
+        for name, v in aux_vals.items():
+            if name in ex.aux_dict:
+                ex.aux_dict[name]._data = v._data
+        training = autograd.is_training()
+        kwargs = dict(inputs)
+        kwargs.update(arg_vals)
+        out = ex.forward(is_train=training, **kwargs)
+        if training:
+            # training mode computes moving-stat updates (executor aux
+            # rules); write them back into the Parameters so exports and
+            # later inference see them
+            for name, v in ex.aux_dict.items():
+                if name in self.params._params:
+                    self.params._params[name].data()._data = v._data
         if isinstance(out, (list, tuple)) and len(out) == 1:
             return out[0]
         return out

@@ -1,3 +1,6 @@
 """Contrib neural network blocks (reference: python/mxnet/gluon/contrib/)."""
 from . import nn
 from . import rnn
+from . import cnn
+from . import data
+from . import estimator

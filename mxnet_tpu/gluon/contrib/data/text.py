@@ -1,0 +1,61 @@
+"""Language-model datasets (reference: gluon/contrib/data/text.py WikiText2/
+WikiText103).
+
+Zero-egress re-design: the reference downloads from the repo bucket; here
+the dataset reads a LOCAL extracted WikiText directory (`root` must contain
+wiki.{train,valid,test}.tokens) and raises with download instructions when
+absent.  Tokenization (whitespace + <eos> per newline) and the flattened
+int32 token-stream sample layout match the reference.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...data.dataset import Dataset
+
+__all__ = ["WikiText2", "WikiText103"]
+
+
+class _WikiText(Dataset):
+    _files = {"train": "wiki.train.tokens", "validation": "wiki.valid.tokens",
+              "test": "wiki.test.tokens"}
+    _name = "wikitext"
+
+    def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        path = os.path.join(os.path.expanduser(root),
+                            self._files[segment])
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                "%s not found. Download and extract the %s archive into %r "
+                "(this framework runs with zero egress, so datasets are "
+                "local-path based)." % (path, self._name, root))
+        with open(path, encoding="utf-8") as f:
+            words = []
+            for line in f:
+                words.extend(line.split())
+                words.append("<eos>")
+        if vocab is None:
+            from ....contrib.text.vocab import Vocabulary
+            from collections import Counter
+            vocab = Vocabulary(Counter(words))
+        self.vocab = vocab
+        idx = np.asarray(vocab.to_indices(words), np.int32)
+        n = (len(idx) - 1) // seq_len * seq_len
+        self._x = idx[:n].reshape(-1, seq_len)
+        self._y = idx[1:n + 1].reshape(-1, seq_len)
+
+    def __getitem__(self, i):
+        return self._x[i], self._y[i]
+
+    def __len__(self):
+        return len(self._x)
+
+
+class WikiText2(_WikiText):
+    _name = "wikitext-2"
+
+
+class WikiText103(_WikiText):
+    _name = "wikitext-103"

@@ -1,0 +1,10 @@
+"""Gluon Estimator (reference: python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    LoggingHandler, CheckpointHandler, EarlyStoppingHandler,
+    StoppingHandler)
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "StoppingHandler"]

@@ -1,0 +1,111 @@
+"""Gluon Estimator — the batteries-included fit loop (reference:
+python/mxnet/gluon/contrib/estimator/estimator.py).
+
+One class owning net + loss + metrics + trainer, dispatching lifecycle
+events to handlers.  The train step itself is the standard record/backward/
+step triple over the hybridized net, so everything under it is the jitted
+CachedOp path.
+"""
+from __future__ import annotations
+
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, LoggingHandler,
+                            StoppingHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None):
+        from ... import Trainer
+        from .... import metric as metric_mod
+        self.net = net
+        self.loss = loss
+        self.train_metrics = list(train_metrics or [metric_mod.Loss()])
+        self.val_metrics = list(val_metrics or [])
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.01})
+        self.stop_training = False
+        self.current_epoch = 0
+        self.processed_batches = 0
+        self.max_epoch = None
+
+    # ------------------------------------------------------------- events
+    def _dispatch(self, handlers, cls, hook):
+        for h in handlers:
+            if isinstance(h, cls):
+                getattr(h, hook)(self)
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (tuple, list)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    # ---------------------------------------------------------------- fit
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batches=None):
+        from .... import autograd, nd
+
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        handlers.append(StoppingHandler(max_epoch=epochs,
+                                        max_batch=batches))
+        self.max_epoch = epochs
+        self.stop_training = False
+        self.processed_batches = 0
+
+        self._dispatch(handlers, TrainBegin, "train_begin")
+        for epoch in range(epochs):
+            self.current_epoch = epoch
+            for m in self.train_metrics:
+                m.reset()
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            self._dispatch(handlers, EpochBegin, "epoch_begin")
+            for batch in train_data:
+                data, label = self._split_batch(batch)
+                if not isinstance(data, nd.NDArray):
+                    data = nd.array(data)
+                if not isinstance(label, nd.NDArray):
+                    label = nd.array(label)
+                self._dispatch(handlers, BatchBegin, "batch_begin")
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label).mean()
+                loss.backward()
+                self.trainer.step(1)
+                for m in self.train_metrics:
+                    if type(m).__name__ == "Loss":
+                        m.update(None, [loss])
+                    else:
+                        m.update([label], [out])
+                self.processed_batches += 1
+                self._dispatch(handlers, BatchEnd, "batch_end")
+                if self.stop_training:
+                    break
+            if val_data is not None:
+                self.evaluate(val_data)
+            self._dispatch(handlers, EpochEnd, "epoch_end")
+            if self.stop_training:
+                break
+        self._dispatch(handlers, TrainEnd, "train_end")
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, val_data):
+        from .... import nd
+        for m in self.val_metrics:
+            m.reset()
+        if hasattr(val_data, "reset"):
+            val_data.reset()
+        for batch in val_data:
+            data, label = self._split_batch(batch)
+            if not isinstance(data, nd.NDArray):
+                data = nd.array(data)
+            if not isinstance(label, nd.NDArray):
+                label = nd.array(label)
+            out = self.net(data)
+            for m in self.val_metrics:
+                m.update([label], [out])
+        return [m.get() for m in self.val_metrics]

@@ -1,0 +1,177 @@
+"""Estimator event handlers (reference:
+python/mxnet/gluon/contrib/estimator/event_handler.py).
+
+Handlers are mixins over six lifecycle hooks; the Estimator calls every
+handler that implements a hook, in priority order.  State shared with the
+Estimator travels on the estimator object itself (``est.*``), not a string
+dict — a deliberate simplification of the reference's attribute plumbing.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "StoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after `max_epoch` epochs or `max_batch` total batches."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+
+    def batch_end(self, estimator):
+        if self.max_batch is not None and \
+                estimator.processed_batches >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator):
+        if self.max_epoch is not None and \
+                estimator.current_epoch + 1 >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochEnd, BatchEnd):
+    """Logs throughput and metric values (reference LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", logger=None):
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("mxnet_tpu.estimator")
+        self._tic = None
+
+    def train_begin(self, estimator):
+        self._tic = time.time()
+        self.logger.info("training begun: %d epochs max",
+                         estimator.max_epoch or -1)
+
+    def batch_end(self, estimator):
+        if isinstance(self.log_interval, int) and \
+                estimator.processed_batches % self.log_interval == 0:
+            self.logger.info("epoch %d batch %d: %s",
+                             estimator.current_epoch,
+                             estimator.processed_batches,
+                             _fmt(estimator.train_metrics))
+
+    def epoch_end(self, estimator):
+        self.logger.info("epoch %d done: %s", estimator.current_epoch,
+                         _fmt(estimator.train_metrics
+                              + estimator.val_metrics))
+
+    def train_end(self, estimator):
+        self.logger.info("training finished in %.1fs",
+                         time.time() - self._tic)
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Saves parameters each epoch; keeps the best by `monitor` when
+    `save_best` (reference CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.mode = mode
+        self.save_best = save_best
+        self.best = None
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _path(self, tag):
+        import os
+        return os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+
+    def epoch_end(self, estimator):
+        estimator.net.save_parameters(
+            self._path("epoch%d" % estimator.current_epoch))
+        if not self.save_best:
+            return
+        val = _metric_value(estimator, self.monitor)
+        if val is None:
+            return
+        better = (self.best is None
+                  or (self.mode == "min" and val < self.best)
+                  or (self.mode == "max" and val > self.best))
+        if better:
+            self.best = val
+            estimator.net.save_parameters(self._path("best"))
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stops when `monitor` fails to improve for `patience` epochs
+    (reference EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.bad_epochs = 0
+
+    def train_begin(self, estimator):
+        self.best = None
+        self.bad_epochs = 0
+
+    def epoch_end(self, estimator):
+        val = _metric_value(estimator, self.monitor)
+        if val is None:
+            return
+        improved = (self.best is None
+                    or (self.mode == "min"
+                        and val < self.best - self.min_delta)
+                    or (self.mode == "max"
+                        and val > self.best + self.min_delta))
+        if improved:
+            self.best = val
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+            if self.bad_epochs >= self.patience:
+                estimator.stop_training = True
+
+
+def _fmt(metrics):
+    return ", ".join("%s=%.4f" % m.get() for m in metrics)
+
+
+def _metric_value(estimator, monitor):
+    for m in estimator.val_metrics + estimator.train_metrics:
+        name, value = m.get()
+        if monitor is None or name == monitor:
+            return value
+    return None

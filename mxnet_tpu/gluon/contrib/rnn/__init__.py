@@ -1,6 +1,14 @@
-"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/).
-
-Conv RNN cells and VariationalDropoutCell are tracked as future parity work;
-the core cells live in mxnet_tpu.gluon.rnn.
-"""
+"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/):
+convolutional RNN/LSTM/GRU cells, variational (locked) dropout, LSTMP."""
 from ...rnn import (RecurrentCell, HybridRecurrentCell)  # noqa: F401
+from .conv_cells import (  # noqa: F401
+    Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+    Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+    Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell,
+    VariationalDropoutCell, LSTMPCell)
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell", "LSTMPCell"]

@@ -12,6 +12,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       waitall, from_jax)
 from .. import random  # noqa: F401 — nd.random.* parity
 from . import sparse  # noqa: F401 — nd.sparse.* (row_sparse/csr) parity
+from . import contrib  # noqa: F401 — nd.contrib.* parity
 from ..ops import registry as _registry
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
@@ -27,6 +28,19 @@ def ones_like(data):
     return _registry.invoke("ones_like", data)
 
 
+def _fill_out(out, res):
+    """Honor the reference's out= contract: write the result into the
+    caller's array(s) and return them (python/mxnet/ndarray op stubs)."""
+    if isinstance(out, (tuple, list)):
+        rs = res if isinstance(res, (tuple, list)) else (res,)
+        for o, r in zip(out, rs):
+            o._set_data(r._data.astype(o._data.dtype))
+        return type(out)(out)
+    r = res[0] if isinstance(res, (tuple, list)) else res
+    out._set_data(r._data.astype(out._data.dtype))
+    return out
+
+
 def __getattr__(name):
     try:
         op = _registry.get(name)
@@ -34,9 +48,10 @@ def __getattr__(name):
         raise AttributeError("module 'nd' has no attribute %r" % (name,)) from None
 
     def fn(*args, **kwargs):
-        kwargs.pop("out", None)
+        out = kwargs.pop("out", None)
         kwargs.pop("name", None)
-        return _registry.apply_op(op, *args, **kwargs)
+        res = _registry.apply_op(op, *args, **kwargs)
+        return _fill_out(out, res) if out is not None else res
 
     fn.__name__ = name
     return fn

@@ -1,0 +1,36 @@
+"""``mx.nd.contrib`` — eager dispatch of contrib ops by their SHORT names.
+
+Reference: the generated ``mxnet.ndarray.contrib`` module (ops registered
+as ``_contrib_*`` surface there without the prefix).  Resolution: exact
+name first (quantized ops and friends register both spellings), then the
+``_contrib_`` prefixed form.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+
+
+def _resolve(name):
+    for candidate in (name, "_contrib_" + name):
+        try:
+            return _registry.get(candidate)
+        except AttributeError:
+            continue
+    raise AttributeError(
+        "module 'nd.contrib' has no attribute %r" % (name,)) from None
+
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    op = _resolve(name)
+
+    def fn(*args, **kwargs):
+        from . import _fill_out
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        res = _registry.apply_op(op, *args, **kwargs)
+        return _fill_out(out, res) if out is not None else res
+
+    fn.__name__ = name
+    return fn

@@ -11,6 +11,7 @@ from __future__ import annotations
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
                      Executor, zeros, ones, _make_op_node)
 from . import subgraph  # noqa: F401  (pass registry / subgraph framework)
+from . import contrib  # noqa: F401 — sym.contrib.* parity
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "Executor", "zeros", "ones", "subgraph"]

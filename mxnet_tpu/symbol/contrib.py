@@ -1,0 +1,18 @@
+"""``mx.sym.contrib`` — lazy Symbol builders for contrib ops by short name
+(reference: generated ``mxnet.symbol.contrib``)."""
+from __future__ import annotations
+
+from .symbol import _make_op_node
+from ..ndarray.contrib import _resolve
+
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    op = _resolve(name)  # raises AttributeError for unknown names
+
+    def build(*args, **kwargs):
+        return _make_op_node(op.name, list(args), kwargs)
+
+    build.__name__ = name
+    return build

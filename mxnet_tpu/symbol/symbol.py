@@ -672,9 +672,13 @@ def _eval_symbol(sym, env, training, aux_updates=None):
             vals = [value(x) if isinstance(x, Symbol) else x
                     for x in node.inputs]
             attrs = dict(node.attrs)
-            if node.op in _STOCHASTIC_OPS or node.op == "Dropout":
-                attrs.setdefault("training", training)
-            elif node.op in ("BatchNorm",):
+            if node.op in _STOCHASTIC_OPS or node.op == "Dropout" \
+                    or node.op in ("BatchNorm",):
+                # the EXECUTOR's is_train decides train-vs-infer semantics;
+                # a `training` attr baked into the node at trace/export
+                # time (e.g. by a gluon layer's hybrid_forward) must not
+                # win — Dropout's always-on behavior is the `mode` attr's
+                # job, not `training`'s
                 attrs["training"] = training
             res = op.fn(*vals, **attrs)
             multi = isinstance(res, (tuple, list))
@@ -734,6 +738,9 @@ class Executor:
         from .. import config as _config
         cache_key = (training, _config.epoch())  # knobs bake in at trace
         if cache_key not in self._fwd_cache:
+            # evict programs compiled under superseded knob epochs
+            self._fwd_cache = {k: v for k, v in self._fwd_cache.items()
+                               if k[1] == cache_key[1]}
             sym = self._symbol
 
             def run(env, key):

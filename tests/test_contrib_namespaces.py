@@ -1,0 +1,62 @@
+"""Contrib namespace parity: nd.contrib / sym.contrib short-name dispatch
+plus the mx.contrib auxiliary modules (reference: generated
+mxnet.ndarray.contrib / mxnet.symbol.contrib and python/mxnet/contrib/
+tensorboard.py, tensorrt.py, io.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_nd_contrib_short_names():
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 8).astype(np.float32))
+    y = mx.nd.contrib.fft(x)           # resolves _contrib_fft
+    assert y.shape == (2, 16)
+    rows, cols = mx.nd.contrib.bipartite_matching(
+        mx.nd.array(np.eye(3, dtype=np.float32)), threshold=0.5)
+    np.testing.assert_array_equal(rows.asnumpy(), [0.0, 1.0, 2.0])
+    with pytest.raises(AttributeError):
+        mx.nd.contrib.not_a_real_op
+
+
+def test_sym_contrib_builds_graph():
+    d = mx.sym.Variable("d")
+    out = mx.sym.contrib.fft(d)
+    x = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+    (res,) = out.eval(d=mx.nd.array(x))
+    ref = mx.nd.contrib.fft(mx.nd.array(x))
+    np.testing.assert_allclose(res.asnumpy(), ref.asnumpy(), rtol=1e-5)
+    # alias module mirrors
+    assert mx.contrib.ndarray.fft(mx.nd.array(x)).shape == (2, 16)
+    assert type(mx.contrib.symbol.fft(d)).__name__ == "Symbol"
+
+
+def test_tensorboard_callback_degrades_without_writer():
+    cb = mx.contrib.tensorboard.LogMetricsCallback("/tmp/tb-test-logs")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1.0])], [mx.nd.array([[0.1, 0.9]])])
+
+    class Param:
+        eval_metric = metric
+
+    cb(Param)
+    cb(Param)
+    assert cb.history["accuracy"] == [1.0, 1.0]
+
+
+def test_tensorrt_gate_redirects():
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        mx.contrib.tensorrt.tensorrt_bind()
+
+
+def test_dataloader_iter_adapter():
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+    ds = ArrayDataset(np.arange(8, dtype=np.float32).reshape(4, 2),
+                      np.arange(4, dtype=np.float32))
+    it = mx.contrib.io.DataLoaderIter(DataLoader(ds, batch_size=2))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 2)
+    it.reset()
+    assert len(list(it)) == 2

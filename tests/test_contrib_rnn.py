@@ -1,0 +1,102 @@
+"""gluon.contrib.rnn: conv recurrent cells, VariationalDropoutCell, LSTMP.
+
+Reference contracts: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py and
+rnn_cell.py (VariationalDropoutCell / LSTMPCell).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+@pytest.mark.parametrize("cls,ndim,nstates", [
+    (crnn.Conv1DRNNCell, 1, 1), (crnn.Conv2DRNNCell, 2, 1),
+    (crnn.Conv3DRNNCell, 3, 1), (crnn.Conv1DLSTMCell, 1, 2),
+    (crnn.Conv2DLSTMCell, 2, 2), (crnn.Conv3DLSTMCell, 3, 2),
+    (crnn.Conv1DGRUCell, 1, 1), (crnn.Conv2DGRUCell, 2, 1),
+    (crnn.Conv3DGRUCell, 3, 1),
+])
+def test_conv_cell_shapes_and_grad(cls, ndim, nstates):
+    spatial = (5,) * ndim
+    cell = cls(input_shape=(3,) + spatial, hidden_channels=4)
+    cell.initialize(mx.init.Xavier())
+    B, T = 2, 3
+    x = mx.nd.random.uniform(shape=(B, T, 3) + spatial)
+    with autograd.record():
+        outs, states = cell.unroll(T, x, layout="NTC", merge_outputs=False)
+        loss = sum((o * o).sum() for o in outs)
+    loss.backward()
+    assert len(outs) == T
+    assert outs[0].shape == (B, 4) + spatial
+    assert len(states) == nstates
+    for s in states:
+        assert s.shape == (B, 4) + spatial
+    g = cell.params.get("i2h_weight").grad()
+    assert float(mx.nd.abs(g).sum().asnumpy()) > 0
+
+
+def test_conv_lstm_step_math():
+    """One Conv2DLSTM step with 1x1 kernels equals the dense LSTM equations
+    applied pixelwise."""
+    cell = crnn.Conv2DLSTMCell(input_shape=(2, 3, 3), hidden_channels=2,
+                               i2h_kernel=(1, 1), h2h_kernel=(1, 1))
+    cell.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.normal(size=(1, 2, 3, 3)).astype(np.float32))
+    h0 = mx.nd.array(rng.normal(size=(1, 2, 3, 3)).astype(np.float32))
+    c0 = mx.nd.array(rng.normal(size=(1, 2, 3, 3)).astype(np.float32))
+    out, (h, c) = cell(x, [h0, c0])
+
+    wi = cell.params.get("i2h_weight").data().asnumpy()[:, :, 0, 0]
+    wh = cell.params.get("h2h_weight").data().asnumpy()[:, :, 0, 0]
+    bi = cell.params.get("i2h_bias").data().asnumpy()
+    bh = cell.params.get("h2h_bias").data().asnumpy()
+    xs = x.asnumpy().transpose(0, 2, 3, 1).reshape(-1, 2)
+    hs = h0.asnumpy().transpose(0, 2, 3, 1).reshape(-1, 2)
+    cs = c0.asnumpy().transpose(0, 2, 3, 1).reshape(-1, 2)
+    z = xs @ wi.T + hs @ wh.T + bi + bh
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    zi, zf, zc, zo = np.split(z, 4, axis=1)
+    c_ref = sig(zf) * cs + sig(zi) * np.tanh(zc)
+    h_ref = sig(zo) * np.tanh(c_ref)
+    got = h.asnumpy().transpose(0, 2, 3, 1).reshape(-1, 2)
+    np.testing.assert_allclose(got, h_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_variational_dropout_same_mask_across_steps():
+    base = crnn.Conv1DRNNCell(input_shape=(1, 4), hidden_channels=1,
+                              i2h_kernel=(1,), h2h_kernel=(1,))
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                       drop_states=0.0)
+    cell.initialize(mx.init.One())
+    T = 4
+    x = mx.nd.ones((1, T, 1, 4))
+    with autograd.record():
+        outs, _ = cell.unroll(T, x, layout="NTC", merge_outputs=False)
+    # ONE mask for the whole unroll, cached on the wrapper
+    m1 = cell._mask_in.asnumpy()
+    assert set(np.unique(m1)).issubset({0.0, 2.0})  # scaled Bernoulli
+    # a second unroll resamples (reset() clears the cache)
+    with autograd.record():
+        cell.unroll(T, x, layout="NTC")
+    assert cell._mask_in is not None
+    # inference mode: no masking at all
+    outs_inf, _ = cell.unroll(T, x, layout="NTC", merge_outputs=False)
+    assert cell._mask_in is None or not autograd.is_training()
+
+
+def test_lstmp_projection_shapes():
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(2, 5, 4))
+    with autograd.record():
+        outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=False)
+        loss = sum((o * o).sum() for o in outs)
+    loss.backward()
+    assert outs[0].shape == (2, 3)          # projected output
+    assert states[0].shape == (2, 3)        # r state
+    assert states[1].shape == (2, 8)        # cell state
+    g = cell.params.get("h2r_weight").grad()
+    assert float(mx.nd.abs(g).sum().asnumpy()) > 0

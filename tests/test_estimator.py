@@ -1,0 +1,91 @@
+"""gluon.contrib.estimator + contrib cnn/data (reference:
+python/mxnet/gluon/contrib/estimator/, cnn/conv_layers.py,
+data/sampler.py).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, CheckpointHandler, EarlyStoppingHandler)
+from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+
+def _toy_data(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+
+
+def test_estimator_fit_improves_metric():
+    net = nn.Dense(3, in_units=8)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[mx.metric.Accuracy()],
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    est.fit(_toy_data(), epochs=5)
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.8, (name, acc)
+
+
+def test_estimator_early_stopping_and_checkpoint(tmp_path):
+    net = nn.Dense(3, in_units=8)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[mx.metric.Loss()])
+    # min_delta larger than any achievable improvement => deterministic
+    # stop after exactly 1 + patience epochs
+    stopper = EarlyStoppingHandler(monitor="loss", mode="min", patience=2,
+                                   min_delta=1e6)
+    ckpt = CheckpointHandler(str(tmp_path), monitor="loss", save_best=True)
+    est.fit(_toy_data(), epochs=50, event_handlers=[stopper, ckpt])
+    assert est.current_epoch == 2, est.current_epoch
+    assert (tmp_path / "model-best.params").exists()
+    assert (tmp_path / ("model-epoch%d.params"
+                        % est.current_epoch)).exists()
+
+
+def test_deformable_convolution_layer():
+    layer = DeformableConvolution(6, kernel_size=(3, 3), padding=(1, 1),
+                                  in_channels=0)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(2, 4, 8, 8))
+    out = layer(x)
+    assert out.shape == (2, 6, 8, 8)
+    # zero-init offsets -> acts as a plain conv of the same weights
+    w = layer.weight.data()
+    b = layer.bias.data()
+    ref = mx.nd.Convolution(x, w, b, kernel=(3, 3), pad=(1, 1),
+                            num_filter=6)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_wikitext_local_files(tmp_path):
+    """WikiText datasets read a LOCAL extracted directory (zero-egress
+    re-design of gluon/contrib/data/text.py) with shifted LM labels."""
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+    (tmp_path / "wiki.train.tokens").write_text(
+        "the cat sat on the mat\nthe dog ran\n")
+    ds = WikiText2(str(tmp_path), segment="train", seq_len=4)
+    assert len(ds) >= 2
+    flat_x = np.concatenate([ds[i][0] for i in range(len(ds))])
+    flat_y = np.concatenate([ds[i][1] for i in range(len(ds))])
+    np.testing.assert_array_equal(flat_x[1:], flat_y[:-1])
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        WikiText2(str(tmp_path), segment="test")
+
+
+def test_interval_sampler():
+    s = IntervalSampler(10, 3)
+    order = list(s)
+    assert order == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    assert len(s) == 10
+    s2 = IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9] and len(s2) == 4

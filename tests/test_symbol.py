@@ -164,3 +164,57 @@ def test_hybrid_block_export_imports_roundtrip(tmp_path):
     re = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
                                    prefix + "-0000.params")
     np.testing.assert_allclose(re(x).asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_block_training_semantics_and_aux_writeback():
+    """An imported SymbolBlock must honor autograd mode: training forward
+    updates BatchNorm moving stats (written back to the block's params) and
+    activates exported Dropout regardless of the attr baked at export; the
+    bound executor is built once (cached jit dispatch)."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.BatchNorm(), nn.Dropout(0.5),
+            nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).normal(
+        2.0, 1.0, (32, 8)).astype(np.float32))
+    net(x)  # warm running stats once so export carries non-trivial aux
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "m")
+    net.export(prefix)
+    re = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+
+    aux_name = [n for n in re.params.keys() if n.endswith("running_mean")][0]
+    before = re.params._params[aux_name].data().asnumpy().copy()
+    # training forward: dropout active (stochastic), aux stats move
+    with autograd.record():
+        o1 = re(x).asnumpy()
+        o2 = re(x).asnumpy()
+    assert not np.allclose(o1, o2), "exported Dropout inactive in training"
+    after = re.params._params[aux_name].data().asnumpy()
+    assert not np.allclose(before, after), "BN moving stats not written back"
+    # inference: deterministic, aux frozen
+    i1 = re(x).asnumpy()
+    i2 = re(x).asnumpy()
+    np.testing.assert_allclose(i1, i2)
+    np.testing.assert_allclose(
+        re.params._params[aux_name].data().asnumpy(), after)
+    # executor is persistent (no rebind per call)
+    assert re._executor is not None
+
+
+def test_nd_out_kwarg_honored():
+    """out= writes into the caller's array (reference op-stub contract)."""
+    x = mx.nd.array(np.array([[1.0, -2.0], [3.0, -4.0]], np.float32))
+    buf = mx.nd.zeros((2, 2))
+    ret = mx.nd.relu(x, out=buf)
+    assert ret is buf
+    np.testing.assert_allclose(buf.asnumpy(), [[1, 0], [3, 0]])
+    buf2 = mx.nd.zeros((2, 4))
+    mx.nd.contrib.fft(x, out=buf2)
+    np.testing.assert_allclose(
+        buf2.asnumpy(), mx.nd.contrib.fft(x).asnumpy(), rtol=1e-6)
