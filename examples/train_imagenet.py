@@ -79,16 +79,17 @@ def main():
         it = mx.io.ImageRecordIter(
             path_imgrec=args.data_train, batch_size=args.batch_size,
             data_shape=shape, shuffle=True)
-        n, total, tic = 0, 0.0, time.time()
+        n, losses, tic = 0, [], time.time()
         for batch in it:
-            loss = trainer.step(batch.data[0], batch.label[0])
-            total += float(np.asarray(loss))
+            # keep losses ON DEVICE during the epoch: a float() here would
+            # sync every step and serialize async dispatch
+            losses.append(trainer.step(batch.data[0], batch.label[0]))
             n += args.batch_size
         if n == 0:
             raise RuntimeError("no batches read from %r" % args.data_train)
+        mean_loss = float(np.mean([np.asarray(l) for l in losses]))
         print("epoch %d: mean loss %.4f, %.0f img/s"
-              % (epoch, total / (n / args.batch_size),
-                 n / (time.time() - tic)))
+              % (epoch, mean_loss, n / (time.time() - tic)))
         trainer.save_checkpoint("%s-%04d.ckpt" % (args.network, epoch))
 
 

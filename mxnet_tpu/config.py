@@ -59,8 +59,11 @@ def set(name, value):  # noqa: A001 — reference-parity name
     # set('x', '0') and ENV_X=0 agree (notably for bools)
     parsed = _parse(knob, value) if isinstance(value, str) \
         else knob.type(value)
-    if name in _OVERRIDES and _OVERRIDES[name] == parsed:
-        return  # no-op set: don't invalidate compiled-program caches
+    if parsed == get(name):
+        # no-op set (same as current override/env/default): don't
+        # invalidate compiled-program caches
+        _OVERRIDES[name] = parsed
+        return
     _OVERRIDES[name] = parsed
     global _EPOCH
     _EPOCH += 1

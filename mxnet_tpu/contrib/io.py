@@ -17,9 +17,11 @@ class DataLoaderIter:
         self.data_name = data_name
         self.label_name = label_name
         # Module.bind reads provide_data/provide_label (DataDesc protocol,
-        # module/base_module.py) — peek one batch from a THROWAWAY iterator
-        # for the shapes, then start clean
-        first = next(iter(loader), None)
+        # module/base_module.py) — peek one batch for the shapes and YIELD
+        # it first, so single-pass iterables (generators) lose nothing
+        self._iter = iter(loader)
+        self._pending = next(self._iter, None)
+        first = self._pending
         if first is None:
             self.provide_data, self.provide_label = [], []
         else:
@@ -29,16 +31,19 @@ class DataLoaderIter:
                 [DataDesc(label_name, tuple(first[1].shape))]
                 if isinstance(first, (list, tuple)) and len(first) > 1
                 else [])
-        self._iter = iter(loader)
 
     def reset(self):
         self._iter = iter(self._loader)
+        self._pending = None
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        batch = next(self._iter)
+        if self._pending is not None:
+            batch, self._pending = self._pending, None
+        else:
+            batch = next(self._iter)
         data, label = (batch[0], batch[1]) if isinstance(
             batch, (list, tuple)) else (batch, None)
         return DataBatch(data=[data],

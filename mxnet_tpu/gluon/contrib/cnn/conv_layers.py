@@ -35,9 +35,12 @@ class DeformableConvolution(HybridBlock):
             num_deformable_group=num_deformable_group,
             no_bias=not use_bias)
         offset_channels = 2 * k[0] * k[1] * num_deformable_group
+        self._offset_channels = offset_channels
         with self.name_scope():
             self.weight = self.params.get(
-                "weight", shape=(channels, in_channels) + k,
+                "weight",
+                shape=(channels, in_channels // groups if in_channels
+                       else 0) + k,
                 init=weight_initializer, allow_deferred_init=True)
             self.bias = self.params.get(
                 "bias", shape=(channels,), init=bias_initializer,
@@ -56,16 +59,18 @@ class DeformableConvolution(HybridBlock):
     def infer_shape(self, x, *args):
         c = x.shape[1]
         k = self._kwargs["kernel"]
-        self.weight.shape = (self._kwargs["num_filter"], c) + k
-        self.offset_weight.shape = (self.offset_weight.shape[0], c) + k
+        self.weight.shape = (self._kwargs["num_filter"],
+                             c // self._kwargs["num_group"]) + k
+        self.offset_weight.shape = (self._offset_channels, c) + k
 
     def hybrid_forward(self, F, x, weight, offset_weight, bias=None,
                        offset_bias=None):
+        # static channel count (a Symbol on the export path has no .shape)
         offset = F.Convolution(
             x, offset_weight, offset_bias,
             kernel=self._kwargs["kernel"], stride=self._kwargs["stride"],
             pad=self._kwargs["pad"], dilate=self._kwargs["dilate"],
-            num_filter=offset_weight.shape[0],
+            num_filter=self._offset_channels,
             no_bias=offset_bias is None)
         out = F.DeformableConvolution(x, offset, weight, bias,
                                       **self._kwargs)

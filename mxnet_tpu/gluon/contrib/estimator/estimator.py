@@ -76,8 +76,9 @@ class Estimator:
                     loss = self.loss(out, label).mean()
                 loss.backward()
                 self.trainer.step(1)
+                from .... import metric as metric_mod
                 for m in self.train_metrics:
-                    if type(m).__name__ == "Loss":
+                    if isinstance(m, metric_mod.Loss):
                         m.update(None, [loss])
                     else:
                         m.update([label], [out])

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "ResizeIter", "PrefetchingIter", "MNISTIter", "LibSVMIter",
-           "ImageDetRecordIter"]
+           "ImageDetRecordIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -554,3 +554,22 @@ class ImageDetRecordIter(DataIter):
                          [_wrap(jnp.asarray(label))], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+def ImageRecordIter(path_imgrec, batch_size, data_shape, **kwargs):
+    """Classification RecordIO iterator (reference:
+    src/io/iter_image_recordio_2.cc, registered as ImageRecordIter).
+
+    Thin factory over mx.image.ImageIter, which implements the decode +
+    augment + batch pipeline; kept here so reference scripts'
+    ``mx.io.ImageRecordIter(...)`` call sites work unchanged.  Augmenter
+    kwargs (resize/rand_crop/rand_mirror/mean/std...) pass through;
+    engine-tuning knobs the XLA runtime owns (preprocess_threads,
+    prefetch_buffer) are accepted and ignored.
+    """
+    from .image import ImageIter
+    for ignored in ("preprocess_threads", "prefetch_buffer", "verify_decode",
+                    "num_backup_threads", "seed", "round_batch"):
+        kwargs.pop(ignored, None)
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     path_imgrec=path_imgrec, **kwargs)

@@ -30,15 +30,27 @@ def ones_like(data):
 
 def _fill_out(out, res):
     """Honor the reference's out= contract: write the result into the
-    caller's array(s) and return them (python/mxnet/ndarray op stubs)."""
+    caller's array(s) and return them (python/mxnet/ndarray op stubs).
+    Shape/count mismatches raise instead of silently reshaping the
+    caller's buffer."""
     if isinstance(out, (tuple, list)):
         rs = res if isinstance(res, (tuple, list)) else (res,)
+        if len(out) != len(rs):
+            raise ValueError("out= expects %d arrays, op produced %d"
+                             % (len(out), len(rs)))
         for o, r in zip(out, rs):
-            o._set_data(r._data.astype(o._data.dtype))
+            _fill_one(o, r)
         return type(out)(out)
     r = res[0] if isinstance(res, (tuple, list)) else res
-    out._set_data(r._data.astype(out._data.dtype))
-    return out
+    return _fill_one(out, r)
+
+
+def _fill_one(o, r):
+    if tuple(o.shape) != tuple(r.shape):
+        raise ValueError("out= shape %s does not match result shape %s"
+                         % (tuple(o.shape), tuple(r.shape)))
+    o._set_data(r._data.astype(o._data.dtype))
+    return o
 
 
 def __getattr__(name):

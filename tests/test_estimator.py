@@ -82,6 +82,28 @@ def test_wikitext_local_files(tmp_path):
         WikiText2(str(tmp_path), segment="test")
 
 
+def test_deformable_convolution_groups_and_export(tmp_path):
+    """groups>1 must shape the weight (O, C//g, kh, kw); the layer must
+    survive the symbolic export path (no Symbol.shape reads)."""
+    from mxnet_tpu.gluon import nn
+    layer = DeformableConvolution(6, kernel_size=(3, 3), padding=(1, 1),
+                                  groups=2)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(2, 4, 8, 8))
+    assert layer(x).shape == (2, 6, 8, 8)
+    assert layer.weight.shape == (6, 2, 3, 3)
+
+    net = nn.HybridSequential()
+    net.add(DeformableConvolution(4, kernel_size=(3, 3), padding=(1, 1)))
+    net.initialize(mx.init.Xavier())
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "dc")
+    net.export(prefix)
+    re = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    np.testing.assert_allclose(re(x).asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
 def test_interval_sampler():
     s = IntervalSampler(10, 3)
     order = list(s)

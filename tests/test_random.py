@@ -1,0 +1,106 @@
+"""Statistical tests of the random samplers + im2rec round trip.
+
+Reference: tests/python/unittest/test_random.py (moment checks of each
+sampler against its distribution) and tools/im2rec.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 20000
+
+
+def _moments(arr):
+    a = arr.asnumpy().ravel()
+    return a.mean(), a.std()
+
+
+def test_uniform_moments():
+    mx.random.seed(7)
+    a, s = _moments(mx.nd.random.uniform(-2.0, 6.0, shape=(N,)))
+    assert abs(a - 2.0) < 0.1
+    assert abs(s - 8.0 / np.sqrt(12)) < 0.1
+
+
+def test_normal_moments():
+    mx.random.seed(8)
+    a, s = _moments(mx.nd.random.normal(3.0, 2.0, shape=(N,)))
+    assert abs(a - 3.0) < 0.1 and abs(s - 2.0) < 0.1
+
+
+def test_gamma_poisson_exponential_moments():
+    mx.random.seed(9)
+    g = mx.nd.random.gamma(4.0, 2.0, shape=(N,))
+    a, s = _moments(g)
+    assert abs(a - 8.0) < 0.3            # k*theta
+    assert abs(s - 4.0) < 0.3            # sqrt(k)*theta
+    p = mx.nd.random.poisson(5.0, shape=(N,))
+    a, s = _moments(p)
+    assert abs(a - 5.0) < 0.15 and abs(s - np.sqrt(5.0)) < 0.15
+    # frontend exponential(scale) => mean = scale (reference
+    # python/mxnet/ndarray/random.py), while the op-level lam is a RATE
+    e = mx.nd.random.exponential(0.5, shape=(N,))
+    a, _ = _moments(e)
+    assert abs(a - 0.5) < 0.05
+    er = mx.nd.sample_exponential(mx.nd.array([0.5]), shape=(N,))
+    assert abs(float(er.asnumpy().mean()) - 2.0) < 0.15
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(10)
+    draws = mx.nd.sample_multinomial(
+        mx.nd.array([[0.1, 0.2, 0.3, 0.4]]), shape=(N,))
+    counts = np.bincount(draws.asnumpy().astype(np.int64).ravel(),
+                         minlength=4) / N
+    np.testing.assert_allclose(counts, [0.1, 0.2, 0.3, 0.4], atol=0.02)
+
+
+def test_seed_reproducibility():
+    mx.random.seed(1234)
+    x1 = mx.nd.random.normal(shape=(16,)).asnumpy()
+    mx.random.seed(1234)
+    x2 = mx.nd.random.normal(shape=(16,)).asnumpy()
+    np.testing.assert_array_equal(x1, x2)
+    x3 = mx.nd.random.normal(shape=(16,)).asnumpy()
+    assert not np.array_equal(x2, x3)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    """tools/im2rec packs a directory into a .rec that ImageRecordIter
+    reads back with the right labels."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rng.randint(0, 255, (40, 52, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / ("%d.jpg" % i))
+    root = str(tmp_path / "imgs")
+    lst = str(tmp_path / "data.lst")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "im2rec.py")
+    r1 = subprocess.run([sys.executable, tools, "--list", lst, root],
+                        capture_output=True, text=True, env=env)
+    assert r1.returncode == 0, r1.stderr
+    r2 = subprocess.run([sys.executable, tools, lst, root, "--resize", "32"],
+                        capture_output=True, text=True, env=env)
+    assert r2.returncode == 0, r2.stderr
+    rec = str(tmp_path / "data.rec")
+    assert os.path.exists(rec) and os.path.exists(str(tmp_path / "data.idx"))
+
+    it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=3,
+                               data_shape=(3, 32, 32))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().astype(int).tolist())
+    assert labels <= {0, 1} and len(labels) == 2

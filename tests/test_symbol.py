@@ -218,3 +218,7 @@ def test_nd_out_kwarg_honored():
     mx.nd.contrib.fft(x, out=buf2)
     np.testing.assert_allclose(
         buf2.asnumpy(), mx.nd.contrib.fft(x).asnumpy(), rtol=1e-6)
+    # mismatched out shapes must raise, not silently reshape the buffer
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mx.nd.relu(x, out=mx.nd.zeros((5,)))

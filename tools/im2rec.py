@@ -1,0 +1,130 @@
+"""im2rec — pack an image folder into RecordIO (.rec/.idx/.lst).
+
+Reference analog: tools/im2rec.py (list generation + multiprocess packing
+into dmlc RecordIO).  Same .lst format (index \t label... \t relpath) and
+the same record framing (mxnet_tpu.recordio is dmlc-compatible), so .rec
+files produced here feed ImageRecordIter / ImageDetRecordIter directly.
+
+Usage:
+    # 1) generate a .lst from a directory tree (subdir name = class)
+    python tools/im2rec.py --list data.lst /path/to/images
+    # 2) pack it
+    python tools/im2rec.py data.lst /path/to/images --resize 256
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..")))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(root, out_lst, train_ratio=1.0, shuffle=True, seed=0):
+    """Walk `root`; each immediate subdirectory is one class label."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(_EXTS):
+                    items.append((os.path.join(c, fn), float(label_of[c])))
+    else:  # flat directory: label 0
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(_EXTS):
+                items.append((fn, 0.0))
+    if shuffle:
+        random.Random(seed).shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    with open(out_lst, "w") as f:
+        for i, (rel, lab) in enumerate(items[:n_train]):
+            f.write("%d\t%.1f\t%s\n" % (i, lab, rel))
+    if train_ratio < 1.0:
+        val_lst = out_lst.rsplit(".", 1)[0] + "_val.lst"
+        with open(val_lst, "w") as f:
+            for i, (rel, lab) in enumerate(items[n_train:]):
+                f.write("%d\t%.1f\t%s\n" % (i, lab, rel))
+    print("wrote %s (%d items, %d classes)"
+          % (out_lst, n_train, max(1, len(classes))))
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack(lst_path, root, out_prefix=None, resize=0, quality=95,
+         img_fmt=".jpg", center_crop=False):
+    from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img
+    from PIL import Image
+    import numpy as np
+
+    prefix = out_prefix or lst_path.rsplit(".", 1)[0]
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(lst_path):
+        path = os.path.join(root, rel)
+        try:
+            img = Image.open(path).convert("RGB")
+        except Exception as e:  # noqa: BLE001
+            print("skip %s: %s" % (path, e), file=sys.stderr)
+            continue
+        if resize:
+            w, h = img.size
+            scale = resize / min(w, h)
+            img = img.resize((max(1, round(w * scale)),
+                              max(1, round(h * scale))))
+        if center_crop:
+            w, h = img.size
+            s = min(w, h)
+            left, top = (w - s) // 2, (h - s) // 2
+            img = img.crop((left, top, left + s, top + s))
+        label = labels[0] if len(labels) == 1 else np.asarray(
+            labels, np.float32)
+        header = IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, pack_img(header, np.asarray(img),
+                                    quality=quality, img_fmt=img_fmt))
+        n += 1
+    rec.close()
+    print("wrote %s.rec / %s.idx (%d records)" % (prefix, prefix, n))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("lst", help="output .lst (with --list) or input .lst")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst instead of packing")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = ap.parse_args()
+
+    if args.list:
+        make_list(args.root, args.lst, train_ratio=args.train_ratio,
+                  shuffle=not args.no_shuffle)
+    else:
+        pack(args.lst, args.root, resize=args.resize,
+             quality=args.quality, img_fmt=args.encoding,
+             center_crop=args.center_crop)
+
+
+if __name__ == "__main__":
+    main()
