@@ -33,7 +33,12 @@ class DataLoaderIter:
                 else [])
 
     def reset(self):
-        self._iter = iter(self._loader)
+        new_it = iter(self._loader)
+        if new_it is self._iter:
+            # single-pass iterable (generator): a real reset is impossible;
+            # keep the peeked batch queued so nothing is lost
+            return
+        self._iter = new_it
         self._pending = None
 
     def __iter__(self):

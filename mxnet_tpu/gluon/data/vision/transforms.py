@@ -72,12 +72,13 @@ class ToTensor(HybridBlock):
         super().__init__()
 
     def hybrid_forward(self, F, x):
-        if isinstance(x, NDArray):
-            arr = x.astype("float32") / 255.0
-            if arr.ndim == 3:
-                return arr.transpose((2, 0, 1))
-            return arr.transpose((0, 3, 1, 2))
-        raise TypeError("ToTensor expects NDArray input")
+        if not isinstance(x, NDArray):
+            # datasets may hand raw numpy through transform_first
+            x = nd_array(x)
+        arr = x.astype("float32") / 255.0
+        if arr.ndim == 3:
+            return arr.transpose((2, 0, 1))
+        return arr.transpose((0, 3, 1, 2))
 
 
 class Normalize(HybridBlock):

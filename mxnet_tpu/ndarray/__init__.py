@@ -53,6 +53,15 @@ def _fill_one(o, r):
     return o
 
 
+def _apply_with_out(op, args, kwargs):
+    """Shared op dispatch with out= handling — one implementation for the
+    nd, nd.contrib, and npx namespaces."""
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    res = _registry.apply_op(op, *args, **kwargs)
+    return _fill_out(out, res) if out is not None else res
+
+
 def __getattr__(name):
     try:
         op = _registry.get(name)
@@ -60,10 +69,7 @@ def __getattr__(name):
         raise AttributeError("module 'nd' has no attribute %r" % (name,)) from None
 
     def fn(*args, **kwargs):
-        out = kwargs.pop("out", None)
-        kwargs.pop("name", None)
-        res = _registry.apply_op(op, *args, **kwargs)
-        return _fill_out(out, res) if out is not None else res
+        return _apply_with_out(op, args, kwargs)
 
     fn.__name__ = name
     return fn

@@ -26,11 +26,8 @@ def __getattr__(name):
     op = _resolve(name)
 
     def fn(*args, **kwargs):
-        from . import _fill_out
-        out = kwargs.pop("out", None)
-        kwargs.pop("name", None)
-        res = _registry.apply_op(op, *args, **kwargs)
-        return _fill_out(out, res) if out is not None else res
+        from . import _apply_with_out
+        return _apply_with_out(op, args, kwargs)
 
     fn.__name__ = name
     return fn

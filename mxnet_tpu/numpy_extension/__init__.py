@@ -61,8 +61,8 @@ def __getattr__(name):
             "module 'npx' has no attribute %r" % (name,)) from None
 
     def fn(*args, **kwargs):
-        kwargs.pop("out", None)
-        return _registry.apply_op(op, *args, **kwargs)
+        from ..ndarray import _apply_with_out
+        return _apply_with_out(op, args, kwargs)
 
     fn.__name__ = name
     return fn

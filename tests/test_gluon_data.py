@@ -1,0 +1,88 @@
+"""Gluon data pipeline semantics (reference:
+tests/python/unittest/test_gluon_data.py): DataLoader batching/workers/
+samplers, vision transforms value checks, dataset composition.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (ArrayDataset, DataLoader, SimpleDataset,
+                                  sampler)
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_dataloader_batching_and_last_batch():
+    ds = ArrayDataset(np.arange(10, dtype=np.float32).reshape(10, 1),
+                      np.arange(10, dtype=np.float32))
+    batches = list(DataLoader(ds, batch_size=4))
+    assert [b[0].shape[0] for b in batches] == [4, 4, 2]
+    batches = list(DataLoader(ds, batch_size=4, last_batch="discard"))
+    assert [b[0].shape[0] for b in batches] == [4, 4]
+    # rollover carries the remainder into the next epoch
+    dl = DataLoader(ds, batch_size=4, last_batch="rollover")
+    assert [b[0].shape[0] for b in dl] == [4, 4]
+    assert [b[0].shape[0] for b in dl] == [4, 4, 4]
+
+
+def test_dataloader_shuffle_covers_all():
+    ds = SimpleDataset(list(range(100)))
+    seen = []
+    for b in DataLoader(ds, batch_size=10, shuffle=True):
+        seen.extend(int(v) for v in b.asnumpy())
+    assert sorted(seen) == list(range(100))
+    assert seen != list(range(100))  # actually shuffled
+
+
+def test_dataloader_workers_match_serial():
+    ds = ArrayDataset(np.arange(32, dtype=np.float32).reshape(32, 1))
+    serial = [b.asnumpy() for b in DataLoader(ds, batch_size=8)]
+    pooled = [b.asnumpy() for b in DataLoader(ds, batch_size=8,
+                                              num_workers=2)]
+    for a, b in zip(serial, pooled):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_batch_sampler_and_custom_sampler():
+    s = sampler.BatchSampler(sampler.SequentialSampler(7), 3, "keep")
+    assert list(s) == [[0, 1, 2], [3, 4, 5], [6]]
+    ds = SimpleDataset(list(range(7)))
+    out = [b.asnumpy().tolist()
+           for b in DataLoader(ds, batch_sampler=s)]
+    assert out[2] == [6]
+
+
+def test_transform_first_keeps_label():
+    ds = ArrayDataset(np.ones((4, 2, 2, 3), dtype=np.uint8) * 100,
+                      np.arange(4, dtype=np.float32))
+    tds = ds.transform_first(transforms.ToTensor())
+    x, y = tds[1]
+    assert x.shape == (3, 2, 2)
+    np.testing.assert_allclose(x.asnumpy(), 100.0 / 255, rtol=1e-5)
+    assert float(y) == 1.0
+
+
+def test_totensor_normalize_values():
+    img = mx.nd.array(np.full((4, 4, 3), 127.5, np.float32).astype(np.uint8))
+    t = transforms.ToTensor()(img)          # HWC uint8 -> CHW [0,1]
+    assert t.shape == (3, 4, 4)
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5),
+                                std=(0.25, 0.25, 0.25))(t)
+    expected = (127.0 / 255 - 0.5) / 0.25
+    np.testing.assert_allclose(norm.asnumpy(), expected, rtol=1e-4)
+
+
+def test_resize_and_centercrop_shapes():
+    img = mx.nd.array(np.random.RandomState(0).randint(
+        0, 255, (10, 20, 3)).astype(np.uint8))
+    assert transforms.Resize((8, 6))(img).shape == (6, 8, 3)  # (w,h) arg
+    assert transforms.CenterCrop((4, 4))(img).shape == (4, 4, 3)
+
+
+def test_compose_pipeline():
+    pipe = transforms.Compose([transforms.Resize(8), transforms.ToTensor()])
+    img = mx.nd.array(np.random.RandomState(1).randint(
+        0, 255, (16, 16, 3)).astype(np.uint8))
+    out = pipe(img)
+    assert out.shape == (3, 8, 8)
+    assert float(out.asnumpy().max()) <= 1.0
