@@ -14,6 +14,11 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from .base import MXNetError
+# legacy-launcher compatibility: a DMLC_ROLE=server/scheduler process exits
+# cleanly at import (the roles are obsolete — dist_sync is peer allreduce)
+from .kvstore_server import _init_kvstore_server_module
+_init_kvstore_server_module()
+del _init_kvstore_server_module
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray
 from . import ndarray as nd
@@ -58,6 +63,15 @@ _LAZY = {
     "config": ".config",
     "library": ".library",
     "rtc": ".rtc",
+    "attribute": ".attribute",
+    "AttrScope": ".attribute",
+    "executor": ".executor",
+    "executor_manager": ".executor_manager",
+    "kvstore_server": ".kvstore_server",
+    "log": ".log",
+    "util": ".util",
+    "registry": ".registry",
+    "libinfo": ".libinfo",
 }
 
 
@@ -65,6 +79,9 @@ def __getattr__(name):
     import importlib
     if name in _LAZY:
         mod = importlib.import_module(_LAZY[name], __name__)
-        globals()[name] = mod
-        return mod
+        # CamelCase entries are classes re-exported from their module
+        # (e.g. mx.AttrScope from mx.attribute)
+        val = getattr(mod, name) if name[:1].isupper() else mod
+        globals()[name] = val
+        return val
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

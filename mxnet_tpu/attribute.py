@@ -1,0 +1,56 @@
+"""Attribute scoping for symbols (reference: python/mxnet/attribute.py
+AttrScope — annotates every symbol created inside the scope, the mechanism
+behind ctx_group model-parallel placement and lr_mult/wd_mult hints)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """Context manager that stamps its attributes onto every Symbol op node
+    created within (stored in the node's annotation map, queryable via
+    Symbol.attr / attr_dict)."""
+
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings (reference "
+                                 "AttrScope contract)")
+        self._attr = kwargs
+
+    @classmethod
+    def _stack(cls):
+        if not hasattr(cls._state, "stack"):
+            cls._state.stack = []
+        return cls._state.stack
+
+    @classmethod
+    def current_attrs(cls):
+        merged = {}
+        for scope in cls._stack():
+            merged.update(scope._attr)
+        return merged
+
+    def get(self, attr=None):
+        """Scope attrs as defaults; EXPLICIT attrs win (reference
+        AttrScope.get: ret = self._attr.copy(); ret.update(attr))."""
+        merged = dict(self.current_attrs())
+        merged.update(self._attr)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        self._stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._stack().pop()
+
+
+def current():
+    return AttrScope.current_attrs()

@@ -1,0 +1,8 @@
+"""``mx.executor`` — facade module (reference: python/mxnet/executor.py).
+
+The Executor class itself lives with the symbol layer (one jit-specialized
+program per shape signature, mxnet_tpu/symbol/symbol.py); this module keeps
+the reference import path working."""
+from .symbol.symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

@@ -1,0 +1,32 @@
+"""``mx.kvstore_server`` (reference: python/mxnet/kvstore_server.py —
+blocks a DMLC_ROLE=server process inside the ps-lite server loop).
+
+TPU-native role collapse: there ARE no server processes — dist_sync is
+peer allreduce over jax.distributed, so every launched process is a
+worker.  `_init_kvstore_server_module` keeps old launch scripts working:
+a process started with the server role exits cleanly instead of waiting
+for pushes that will never arrive.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        # nothing to serve: the merge happens in the workers' collective
+        return
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "")
+    if role in ("server", "scheduler"):
+        print("mxnet_tpu: role %r is obsolete (dist_sync is peer "
+              "allreduce); exiting cleanly" % role, file=sys.stderr)
+        sys.exit(0)

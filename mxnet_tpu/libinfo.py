@@ -1,0 +1,14 @@
+"""``mx.libinfo`` — version + feature info (reference:
+python/mxnet/libinfo.py; feature flags include/mxnet/libinfo.h)."""
+from .runtime import Features  # noqa: F401
+
+__version__ = "1.6.0.tpu"
+
+
+def find_lib_path():
+    """No shared core library: the 'engine' is jax/XLA (documented
+    redesign).  Returns the native IO helper if built."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.join(here, "native", "libmxtpu_native.so")
+    return [cand] if os.path.exists(cand) else []

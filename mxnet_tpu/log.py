@@ -1,0 +1,27 @@
+"""``mx.log`` — logging helpers (reference: python/mxnet/log.py)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger", "getLogger"]
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode="a", level=logging.WARNING):
+    logger = logging.getLogger(name)
+    # init-once guard (reference log.py _init_done): repeat calls must not
+    # stack handlers and double every message
+    if not getattr(logger, "_mxtpu_log_init", False):
+        if filename:
+            handler = logging.FileHandler(filename, filemode)
+        else:
+            handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(handler)
+        logger._mxtpu_log_init = True
+    logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger  # reference alias

@@ -1,0 +1,61 @@
+"""``mx.registry`` — generic class-registry helpers (reference:
+python/mxnet/registry.py get_register_func/get_create_func, the machinery
+behind the optimizer/initializer/lr_scheduler registries)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "cannot register %s as %s" % (klass, nickname)
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+
+    register.__doc__ = "Register a %s subclass." % nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def wrap(klass):
+            for a in aliases:
+                register(klass, a)
+            return klass
+        return wrap
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            return name
+        if name.startswith("{"):  # json spec {"nickname": ..., params...}
+            spec = json.loads(name)
+            name = spec.pop(nickname)
+            kwargs.update(spec)
+        return reg[name.lower()](*args, **kwargs)
+
+    create.__doc__ = "Create a %s instance by name." % nickname
+    return create

@@ -338,6 +338,11 @@ def Variable(name, shape=None, dtype=None, init=None, **attr_kwargs):
         s.attrs["shape"] = tuple(shape)
     if dtype is not None:
         s.attrs["dtype"] = _np.dtype(dtype).name
+    # AttrScope annotations apply to Variables too (the scope's primary
+    # consumers are parameter attrs: lr_mult/__init__/ctx_group), with
+    # explicit per-variable attrs winning over the scope
+    from ..attribute import AttrScope
+    s._attr_map.update(AttrScope.current_attrs())
     s._attr_map.update({k: str(v) for k, v in attr_kwargs.items()})
     return s
 
@@ -428,7 +433,13 @@ def _make_op_node(opname, inputs, attrs):
         if isinstance(x, NDArray):
             x = x._data  # constant capture
         norm_inputs.append(x)
-    return Symbol("op", name, op=op.name, attrs=attrs, inputs=norm_inputs)
+    node = Symbol("op", name, op=op.name, attrs=attrs, inputs=norm_inputs)
+    # annotation attrs from the enclosing AttrScope (ctx_group, lr_mult...)
+    from ..attribute import AttrScope
+    scope_attrs = AttrScope.current_attrs()
+    if scope_attrs:
+        node._attr_map.update(scope_attrs)
+    return node
 
 
 # Parameter-shape rules: given op attrs + the data-input shape, the shapes of

@@ -1,0 +1,9 @@
+"""``mx.util`` — misc API helpers (reference: python/mxnet/util.py: np-mode
+switches and decorators).  The real switches live in mx.npx; re-exported
+here for reference import-path parity."""
+from .numpy_extension import (  # noqa: F401
+    is_np_array, is_np_shape, set_np, reset_np, set_np_shape,
+    use_np, use_np_array, use_np_shape)
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np",
+           "set_np_shape", "use_np", "use_np_array", "use_np_shape"]

@@ -1,0 +1,110 @@
+"""Top-level module parity: attribute/executor/executor_manager/
+kvstore_server/log/util/registry/libinfo (reference: python/mxnet/*.py).
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_attr_scope_annotates_symbols():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        a = mx.sym.Variable("a")
+        out = mx.sym.relu(a)
+    assert out.attr("ctx_group") == "dev1"
+    assert out.attr("lr_mult") == "0.1"
+    assert out.attr_dict()[out.name]["ctx_group"] == "dev1"
+    # outside the scope: unannotated
+    out2 = mx.sym.relu(mx.sym.Variable("b"))
+    assert out2.attr("ctx_group") is None
+    # nesting merges inner-over-outer
+    with mx.AttrScope(ctx_group="dev1"):
+        with mx.AttrScope(ctx_group="dev2"):
+            inner = mx.sym.relu(mx.sym.Variable("c"))
+    assert inner.attr("ctx_group") == "dev2"
+    with pytest.raises(ValueError):
+        mx.AttrScope(lr_mult=0.1)  # non-string rejected
+    # Variables are annotated too (the scope's primary consumers are
+    # parameter attrs), and explicit attrs beat the scope
+    with mx.AttrScope(lr_mult="0.1", ctx_group="dev1"):
+        v = mx.sym.Variable("w", lr_mult="2.0")
+    assert v.attr("lr_mult") == "2.0"
+    assert v.attr("ctx_group") == "dev1"
+    scope = mx.AttrScope(lr_mult="0.1")
+    assert scope.get({"lr_mult": "1.0"})["lr_mult"] == "1.0"
+
+
+def test_executor_and_manager_facades():
+    from mxnet_tpu.executor import Executor
+    from mxnet_tpu.executor_manager import _split_input_slice
+    assert Executor is mx.sym.Executor
+    slices = _split_input_slice(10, [1, 1, 2])
+    widths = [s.stop - s.start for s in slices]
+    assert sum(widths) == 10 and all(w > 0 for w in widths)
+    assert widths[2] > widths[0]  # heavier workload gets the bigger slice
+    assert slices[0].start == 0 and slices[-1].stop == 10
+
+
+def test_kvstore_server_role_collapse(monkeypatch):
+    import mxnet_tpu.kvstore_server as kvs
+    srv = kvs.KVStoreServer(None)
+    srv.run()  # no-op, returns
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    with pytest.raises(SystemExit):
+        kvs._init_kvstore_server_module()
+
+
+def test_server_role_exits_at_import():
+    import os, subprocess, sys
+    env = dict(os.environ, DMLC_ROLE="server", JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", "import mxnet_tpu"],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "obsolete" in r.stderr
+
+
+def test_log_get_logger():
+    logger = mx.log.get_logger("mxtest", level=logging.INFO)
+    assert logger.level == logging.INFO and logger.handlers
+    n = len(logger.handlers)
+    mx.log.get_logger("mxtest")  # init-once: no handler stacking
+    assert len(logger.handlers) == n
+
+
+def test_registry_register_create():
+    from mxnet_tpu.registry import (get_register_func, get_alias_func,
+                                    get_create_func)
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = get_register_func(Base, "thing")
+    alias = get_alias_func(Base, "thing")
+    create = get_create_func(Base, "thing")
+
+    @register
+    @alias("short")
+    class MyThing(Base):
+        pass
+
+    assert isinstance(create("mything"), MyThing)
+    assert isinstance(create("short", x=5), MyThing)
+    assert create("short", x=5).x == 5
+    inst = MyThing()
+    assert create(inst) is inst
+    assert create('{"thing": "mything", "x": 3}').x == 3
+
+
+def test_libinfo_and_util():
+    assert mx.libinfo.__version__.endswith("tpu")
+    from mxnet_tpu.util import set_np, is_np_array, reset_np
+    set_np()
+    assert is_np_array()
+    reset_np()
+    assert not is_np_array()
