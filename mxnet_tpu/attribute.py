@@ -36,10 +36,11 @@ class AttrScope:
         return merged
 
     def get(self, attr=None):
-        """Scope attrs as defaults; EXPLICIT attrs win (reference
-        AttrScope.get: ret = self._attr.copy(); ret.update(attr))."""
-        merged = dict(self.current_attrs())
-        merged.update(self._attr)
+        """THIS scope's attrs as defaults; EXPLICIT attrs win (reference
+        AttrScope.get: ret = self._attr.copy(); ret.update(attr)).  The
+        ambient stack is deliberately not consulted — that merge belongs
+        to symbol creation (current_attrs), not to reading one scope."""
+        merged = dict(self._attr)
         if attr:
             merged.update(attr)
         return merged

@@ -23,7 +23,7 @@ from .ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
            "Constant", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
-           "Bilinear", "LSTMBias", "Mixed", "Load"]
+           "Bilinear", "LSTMBias", "FusedRNN", "Mixed", "Load"]
 
 _INIT_REGISTRY = {}
 
@@ -264,6 +264,45 @@ class LSTMBias(Initializer):
         num_hidden = int(shape[0] / 4)
         b[num_hidden:2 * num_hidden] = self.forget_bias
         return jnp.asarray(b, dtype_np(dtype))
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize fused-RNN parameters (reference: initializer.py:715).
+
+    The reference unpacks cuDNN's single packed parameter blob, applies
+    `init` to the unpacked weights, and sets the LSTM forget-gate bias.
+    This framework's fused RNN layers keep SEPARATE gate-stacked
+    parameters (gluon/rnn/rnn_layer.py, cuDNN row order i,f,c,o), so the
+    same contract maps by NAME: weights get `init`, biases get zeros with
+    `forget_bias` written into the forget-gate rows of LSTM biases.
+    """
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            spec = json.loads(init)
+            init = create(spec[0], **spec[1])
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._inner = init
+        self._num_hidden = num_hidden
+        self._mode = mode
+        self._forget_bias = forget_bias
+
+    def generate(self, key, shape, dtype="float32", name=""):
+        lname = name.lower()
+        if "bias" in lname:
+            b = _np.zeros(shape, "float32")
+            if self._mode == "lstm" and "i2h" in lname:
+                h = self._num_hidden
+                b[h:2 * h] = self._forget_bias
+            return jnp.asarray(b, dtype_np(dtype))
+        if self._inner is not None:
+            return self._inner.generate(key, shape, dtype, name=name)
+        return Uniform(0.07).generate(key, shape, dtype, name=name)
 
 
 class Mixed:

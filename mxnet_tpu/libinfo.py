@@ -9,6 +9,5 @@ def find_lib_path():
     """No shared core library: the 'engine' is jax/XLA (documented
     redesign).  Returns the native IO helper if built."""
     import os
-    here = os.path.dirname(os.path.abspath(__file__))
-    cand = os.path.join(here, "native", "libmxtpu_native.so")
-    return [cand] if os.path.exists(cand) else []
+    from .native.lib import _SO
+    return [_SO] if os.path.exists(_SO) else []

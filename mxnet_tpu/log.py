@@ -10,6 +10,10 @@ _FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
 def get_logger(name=None, filename=None, filemode="a", level=logging.WARNING):
     logger = logging.getLogger(name)
+    if name is None and filename is None:
+        # never hijack the ROOT logger's handlers/level from a library
+        # helper (reference log.py configures named loggers only)
+        return logger
     # init-once guard (reference log.py _init_done): repeat calls must not
     # stack handlers and double every message
     if not getattr(logger, "_mxtpu_log_init", False):

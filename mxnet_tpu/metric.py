@@ -26,7 +26,7 @@ import numpy
 from .ndarray.ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "F1", "MCC", "PCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
            "Caffe", "CustomMetric", "np", "create", "register"]
 
@@ -664,6 +664,74 @@ class PearsonCorrelation(EvalMetric):
         spread = math.sqrt(max(n * sll - sl * sl, 0.0)) * \
             math.sqrt(max(n * spp - sp * sp, 0.0))
         return (self.name, cov / spread if spread else float("nan"))
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Matthews/Pearson correlation from a streaming K x K
+    confusion matrix (reference: metric.py:1473).
+
+    Computed in the standard trace form: with s total samples, c the
+    confusion trace, p_k predicted-class counts and t_k true-class counts,
+    MCC = (c*s - p.t) / sqrt((s^2 - p.p)(s^2 - t.t)) — algebraically the
+    K-class generalization of the binary MCC; the matrix grows on demand
+    when new class ids appear."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def reset(self):
+        super().reset()
+        self._window = numpy.zeros((0, 0), numpy.float64)
+        self._run = numpy.zeros((0, 0), numpy.float64)
+
+    def reset_local(self):
+        super().reset_local()
+        self._window = numpy.zeros((0, 0), numpy.float64)
+
+    @staticmethod
+    def _grown(conf, k):
+        if k <= conf.shape[0]:
+            return conf
+        out = numpy.zeros((k, k), numpy.float64)
+        out[:conf.shape[0], :conf.shape[0]] = conf
+        return out
+
+    def update(self, labels, preds):
+        for label, pred in _paired(labels, preds):
+            label = numpy.asarray(_host(label)).ravel().astype(numpy.int64)
+            p = numpy.asarray(_host(pred))
+            pred_ids = p.argmax(-1).ravel().astype(numpy.int64) \
+                if p.ndim > 1 and p.shape[-1] > 1 else \
+                numpy.round(p.ravel()).astype(numpy.int64)
+            check_label_shapes(label, pred_ids)
+            k = int(max(label.max(), pred_ids.max())) + 1
+            self._window = self._grown(self._window, k)
+            self._run = self._grown(self._run, k)
+            counts = numpy.zeros_like(self._window)
+            numpy.add.at(counts, (label, pred_ids), 1.0)
+            self._window += counts
+            self._run += counts
+            self._tally.add(0.0, label.size)
+
+    @staticmethod
+    def _score(conf):
+        s = conf.sum()
+        if s == 0:
+            return float("nan")
+        c = numpy.trace(conf)
+        t = conf.sum(axis=1)   # true-class counts
+        p = conf.sum(axis=0)   # predicted-class counts
+        denom = math.sqrt(max(s * s - (p * p).sum(), 0.0)) * \
+            math.sqrt(max(s * s - (t * t).sum(), 0.0))
+        return float((c * s - (t * p).sum()) / denom) if denom else 0.0
+
+    def get(self):
+        return (self.name, self._score(self._window))
+
+    def get_global(self):
+        return (self.name, self._score(self._run))
 
 
 @register

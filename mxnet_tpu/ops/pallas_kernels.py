@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from .registry import register
 
-__all__ = ["pallas_row_softmax", "pallas_scale_bias_relu"]
+__all__ = ["pallas_row_softmax", "pallas_scale_bias_relu",
+           "pallas_flash_attention"]
 
 
 def _row_softmax_kernel(x_ref, o_ref):
@@ -74,6 +75,81 @@ def pallas_row_softmax(data, **_):
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
         interpret=interpret_mode())(flat)
     return out.reshape(x.shape)
+
+
+def _flash_attention_kernel(scale, causal, block_q, q_ref, k_ref, v_ref,
+                            o_ref):
+    """One q block vs the full K/V of its (batch, head) slice.
+
+    The score matrix [block_q, S] lives only in VMEM — it is never
+    materialized in HBM, which is the whole point of flash attention: HBM
+    traffic is O(S*D) instead of O(S^2).  Softmax accumulates in f32 on
+    chip; the MXU does both matmuls.
+    """
+    from jax.experimental import pallas as pl
+    q = q_ref[0].astype(jnp.float32)                # [bq, D]
+    k = k_ref[0].astype(jnp.float32)                # [S, D]
+    v = v_ref[0]                                    # [S, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        i = pl.program_id(1)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= q_pos, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    acc = jax.lax.dot_general(e.astype(v.dtype), v,
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[0] = (acc / jnp.sum(e, axis=-1, keepdims=True)).astype(
+        o_ref.dtype)
+
+
+@register("pallas_flash_attention", differentiable=False)
+def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                           **_):
+    """Flash attention via Pallas (mx.nd.pallas_flash_attention).
+
+    q/k/v: [B, H, S, D].  The grid walks (batch*heads, q blocks); each
+    step holds one q block plus its head's full K/V in VMEM (S*D per
+    operand — S=8k at D=128 bf16 is 2MB, comfortably on chip), so the
+    S x S score matrix never touches HBM.  Sequences larger than VMEM
+    shard S over the 'sp' mesh axis first (parallel.ring_attention) and
+    run this kernel per shard.  Forward-only by design — training uses
+    the XLA attention whose backward XLA fuses well; this is the
+    inference escape hatch (reference analog: hand-written fused CUDA
+    attention via RTC, src/common/rtc.cc).
+    """
+    import math
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    import functools
+
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    B, H, S, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    # largest divisor of S <= block_q, so an awkward block_q degrades to
+    # the best legal tiling instead of cliff-diving to 1-row blocks
+    bq = _row_block(S, 1, budget=min(block_q, S))
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    kernel = functools.partial(_flash_attention_kernel, scale, bool(causal),
+                               bq)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(B * H, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        interpret=interpret_mode())(qf, kf, vf)
+    return out.reshape(B, H, S, D)
 
 
 @register("pallas_scale_bias_relu", differentiable=False)

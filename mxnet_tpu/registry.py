@@ -50,12 +50,20 @@ def get_create_func(base_class, nickname):
         else:
             name = kwargs.pop(nickname)
         if isinstance(name, base_class):
+            if args or kwargs:
+                raise ValueError(
+                    "%s is already an instance; extra arguments %r %r "
+                    "would be silently dropped" % (nickname, args, kwargs))
             return name
         if name.startswith("{"):  # json spec {"nickname": ..., params...}
             spec = json.loads(name)
             name = spec.pop(nickname)
             kwargs.update(spec)
-        return reg[name.lower()](*args, **kwargs)
+        key = name.lower()
+        if key not in reg:
+            raise ValueError("unknown %s %r (registered: %s)"
+                             % (nickname, name, sorted(reg)))
+        return reg[key](*args, **kwargs)
 
     create.__doc__ = "Create a %s instance by name." % nickname
     return create

@@ -673,6 +673,20 @@ spec("pallas_scale_bias_relu", inputs=lambda: [rnd(3, 8), pos(8), rnd(8)],
      ref=lambda x, s, b, **_: np.maximum(x * s + b, 0),
      fwd_only="pallas kernel; registered non-differentiable")
 
+
+def _np_attention(q, k, v, **_):
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+spec("pallas_flash_attention",
+     inputs=lambda: [rnd(1, 2, 4, 8), rnd(1, 2, 4, 8), rnd(1, 2, 4, 8)],
+     ref=_np_attention,
+     fwd_only="pallas kernel; registered non-differentiable "
+              "(inference escape hatch; training uses XLA attention)")
+
 # MultiBoxTarget/Detection-style ops registered under other names get their
 # own specs here if present; the meta test below catches any addition that
 # forgets to add one.

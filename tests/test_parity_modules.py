@@ -95,6 +95,10 @@ def test_registry_register_create():
 
     assert isinstance(create("mything"), MyThing)
     assert isinstance(create("short", x=5), MyThing)
+    with pytest.raises(ValueError):
+        create("nope")
+    with pytest.raises(ValueError):
+        create(MyThing(), x=9)  # extra args on an instance must raise
     assert create("short", x=5).x == 5
     inst = MyThing()
     assert create(inst) is inst
@@ -108,3 +112,51 @@ def test_libinfo_and_util():
     assert is_np_array()
     reset_np()
     assert not is_np_array()
+
+
+def test_pcc_metric_matches_binary_mcc():
+    """PCC on a 2-class confusion equals the binary Matthews correlation."""
+    m = mx.metric.PCC()
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 200)
+    scores = rng.rand(200, 2)
+    preds = scores.argmax(1)
+    m.update([mx.nd.array(labels.astype(np.float32))],
+             [mx.nd.array(scores.astype(np.float32))])
+    tp = int(((preds == 1) & (labels == 1)).sum())
+    tn = int(((preds == 0) & (labels == 0)).sum())
+    fp = int(((preds == 1) & (labels == 0)).sum())
+    fn = int(((preds == 0) & (labels == 1)).sum())
+    denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+    mcc = (tp * tn - fp * fn) / denom if denom else 0.0
+    name, got = m.get()
+    assert name == "pcc"
+    np.testing.assert_allclose(got, mcc, rtol=1e-10)
+    # perfect prediction -> exactly +1
+    m2 = mx.metric.PCC()
+    m2.update([mx.nd.array([0, 1, 2, 1.0])],
+              [mx.nd.array(np.eye(3)[[0, 1, 2, 1]].astype(np.float32))])
+    assert abs(m2.get()[1] - 1.0) < 1e-12
+    # global scope survives reset_local; local window clears
+    m2.reset_local()
+    assert np.isnan(m2.get()[1])
+    assert abs(m2.get_global()[1] - 1.0) < 1e-12
+
+
+def test_fused_rnn_initializer():
+    """FusedRNN: inner init on weights; zero biases with the forget-gate
+    rows (LSTM i2h, rows H..2H) at forget_bias."""
+    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=4, num_layers=1,
+                            mode="lstm", forget_bias=2.0)
+    from mxnet_tpu.initializer import InitDesc
+    from mxnet_tpu.ndarray.ndarray import _wrap
+    import jax.numpy as jnp
+    bias = _wrap(jnp.full((16,), 7.0))
+    init(InitDesc("lstm_l0_i2h_bias"), bias)
+    b = bias.asnumpy()
+    np.testing.assert_array_equal(b[4:8], 2.0)
+    np.testing.assert_array_equal(b[:4], 0.0)
+    np.testing.assert_array_equal(b[8:], 0.0)
+    w = _wrap(jnp.zeros((16, 8)))
+    init(InitDesc("lstm_l0_i2h_weight"), w)
+    assert float(np.abs(w.asnumpy()).sum()) > 0  # inner init applied

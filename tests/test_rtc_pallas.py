@@ -80,3 +80,31 @@ def test_pallas_kernel_with_grid_blocks():
         out_specs=pl.BlockSpec((4, 8), lambda i: (i, 0)))
     out = k.launch([mx.nd.array(x)])
     np.testing.assert_allclose(out.asnumpy(), 4 * x)
+
+
+def test_pallas_flash_attention_matches_reference():
+    """Flash attention kernel == full XLA attention (interpret mode on
+    CPU), causal and non-causal, with a block size that forces multiple
+    q blocks."""
+    from mxnet_tpu.parallel import attention
+    rng = np.random.RandomState(0)
+    B, H, S, D = 2, 3, 16, 8
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    for causal in (False, True):
+        got = mx.nd.pallas_flash_attention(q, k, v, causal=causal,
+                                           block_q=4)
+        ref = attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_flash_attention_non_pow2_block():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.normal(size=(1, 2, 6, 4)), jnp.float32)
+    out = mx.nd.pallas_flash_attention(q, q, q, block_q=4)  # 6 % 4 != 0
+    from mxnet_tpu.parallel import attention
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(attention(q, q, q)),
+                               rtol=2e-4, atol=2e-5)
