@@ -707,12 +707,13 @@ class PCC(EvalMetric):
                 numpy.round(p.ravel()).astype(numpy.int64)
             check_label_shapes(label, pred_ids)
             k = int(max(label.max(), pred_ids.max())) + 1
+            # each scope grows independently (after reset_local the window
+            # is smaller than the run matrix), so scatter into each at its
+            # own size
             self._window = self._grown(self._window, k)
             self._run = self._grown(self._run, k)
-            counts = numpy.zeros_like(self._window)
-            numpy.add.at(counts, (label, pred_ids), 1.0)
-            self._window += counts
-            self._run += counts
+            numpy.add.at(self._window, (label, pred_ids), 1.0)
+            numpy.add.at(self._run, (label, pred_ids), 1.0)
             self._tally.add(0.0, label.size)
 
     @staticmethod

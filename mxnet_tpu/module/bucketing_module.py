@@ -72,16 +72,20 @@ class BucketingModule(BaseModule):
             if self.params_initialized:
                 arg, aux = self._buckets[
                     self._default_bucket_key].get_params()
-                mod.init_params(arg_params=arg, aux_params=aux,
-                                allow_missing=False, force_init=True)
+                # set-params-only: a bucket param missing from the shared
+                # set must RAISE, never be silently random-initialized
+                mod.init_params(initializer=None, arg_params=arg,
+                                aux_params=aux, allow_missing=False,
+                                force_init=True)
             if self.optimizer_initialized:
                 self._share_optimizer(mod)
             self._buckets[bucket_key] = mod
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
 
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
+    def init_params(self, initializer="default", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         assert self.binded
         if self.params_initialized and not force_init:
             return

@@ -108,11 +108,18 @@ class Module(BaseModule):
         self._inputs_need_grad = inputs_need_grad
 
     # -------------------------------------------------------------- params
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
+    def init_params(self, initializer="default", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         assert self.binded
         if self.params_initialized and not force_init:
             return
+        if initializer == "default":
+            # reference default: base_module.py:640 Uniform(0.01); an
+            # explicit None still means "values must come from
+            # arg_params/aux_params"
+            from ..initializer import Uniform
+            initializer = Uniform(0.01)
         attr_map = self._symbol.attr_dict()
         for name in self._param_names:
             arr = self._exec.arg_dict[name]

@@ -131,13 +131,20 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     k = jnp.asarray(k)
     v = jnp.asarray(v)
     B, H, S, D = q.shape
+    Skv = k.shape[2]
+    if causal and Skv != S:
+        raise ValueError("causal flash attention needs matching q/kv "
+                         "lengths, got Sq=%d Skv=%d" % (S, Skv))
+    if v.shape != k.shape:
+        raise ValueError("k and v shapes differ: %s vs %s"
+                         % (k.shape, v.shape))
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     # largest divisor of S <= block_q, so an awkward block_q degrades to
     # the best legal tiling instead of cliff-diving to 1-row blocks
     bq = _row_block(S, 1, budget=min(block_q, S))
     qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
+    kf = k.reshape(B * H, Skv, D)
+    vf = v.reshape(B * H, Skv, D)
     kernel = functools.partial(_flash_attention_kernel, scale, bool(causal),
                                bq)
     out = pl.pallas_call(
@@ -145,8 +152,8 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(B * H, S // bq),
         in_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-                  pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))],
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0))],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         interpret=interpret_mode())(qf, kf, vf)
     return out.reshape(B, H, S, D)

@@ -1,0 +1,78 @@
+"""Engine facade semantics (reference: tests/python/unittest/test_engine.py
++ MXNET_ENGINE_TYPE selection, src/engine/engine.cc:32-41).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+
+
+def test_bulk_context_manager_restores():
+    prev = engine.set_bulk_size(0)
+    try:
+        with engine.bulk(16):
+            assert engine._BULK_SIZE[0] == 16
+            with engine.bulk(4):
+                assert engine._BULK_SIZE[0] == 4
+            assert engine._BULK_SIZE[0] == 16
+        assert engine._BULK_SIZE[0] == 0
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def test_engine_type_selection_and_validation():
+    prev = engine.engine_type()
+    try:
+        engine.set_engine_type("NaiveEngine")
+        assert engine.naive_engine_enabled()
+        engine.set_engine_type("ThreadedEngine")
+        assert not engine.naive_engine_enabled()
+        with pytest.raises(AssertionError):
+            engine.set_engine_type("BogusEngine")
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_naive_engine_numerics_identical():
+    """NaiveEngine (sync per-op) must not change results — it is purely an
+    execution-order debugging mode, like the reference's serial engine."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    prev = engine.engine_type()
+
+    def compute():
+        a = mx.nd.array(x)
+        b = mx.nd.dot(a, a.T)
+        c = mx.nd.relu(b - 1.0)
+        return mx.nd.sum(c).asnumpy()
+
+    try:
+        engine.set_engine_type("ThreadedEngine")
+        threaded = compute()
+        engine.set_engine_type("NaiveEngine")
+        naive = compute()
+    finally:
+        engine.set_engine_type(prev)
+    np.testing.assert_allclose(threaded, naive, rtol=1e-6)
+
+
+def test_naive_engine_autograd_training_step():
+    """A record/backward/update step runs identically under NaiveEngine —
+    the mode the reference uses to bisect scheduling races."""
+    from mxnet_tpu import autograd, gluon
+    prev = engine.engine_type()
+    try:
+        engine.set_engine_type("NaiveEngine")
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        x = mx.nd.random.uniform(shape=(4, 3))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(1)
+        assert np.isfinite(float(loss.asnumpy()))
+    finally:
+        engine.set_engine_type(prev)

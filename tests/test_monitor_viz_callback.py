@@ -1,0 +1,74 @@
+"""Monitor, visualization, and callback facades (reference:
+python/mxnet/monitor.py, visualization.py, callback.py;
+tests/python/unittest/test_viz.py).
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    a = mx.sym.Activation(h, act_type="relu", name="act1")
+    out = mx.sym.FullyConnected(a, num_hidden=3, name="fc2")
+    return mx.sym.softmax(out, name="out")
+
+
+def test_monitor_collects_stats():
+    out = _mlp()
+    ex = out.simple_bind(data=(4, 6))
+    mon = mx.monitor.Monitor(interval=1, pattern="fc.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(data=np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    stats = mon.toc()
+    assert stats, "monitor collected nothing"
+    names = {k for _, k, _ in stats}
+    assert any("fc1" in n for n in names)
+    assert not any("act1" in n for n in names)  # pattern filter works
+    # toc_print path exercises formatting
+    mon.tic()
+    ex.forward(data=np.zeros((4, 6), np.float32))
+    mon.toc_print()
+
+
+def test_print_summary_and_plot_network():
+    out = _mlp()
+    text = mx.viz.print_summary(out, shape={"data": (4, 6)})
+    # total param count: fc1 (6*8+8) + fc2 (8*3+3) = 83
+    assert "83" in str(text) or text is None  # reference prints to stdout
+    dot = mx.viz.plot_network(out, shape={"data": (4, 6)})
+    # graphviz may be absent in this image: accept a gated None, otherwise
+    # the dot source must contain the op nodes
+    if dot is not None:
+        src = getattr(dot, "source", str(dot))
+        assert "fc1" in src and "fc2" in src
+
+
+def test_speedometer_and_checkpoint_callbacks(tmp_path, caplog):
+    from mxnet_tpu.callback import Speedometer, do_checkpoint
+
+    class Param:
+        epoch, nbatch = 0, 0
+        eval_metric = None
+        locals = None
+
+    sp = Speedometer(batch_size=4, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 7):
+            Param.nbatch = nb
+            sp(Param)
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+    # do_checkpoint saves symbol+params through the Module path
+    net = _mlp()
+    mod = mx.mod.Module(net, label_names=None)
+    mod.bind([("data", (4, 6))], for_training=False)
+    mod.init_params()
+    cb = do_checkpoint(str(tmp_path / "cp"), period=1)
+    cb(0, mod.symbol, *mod.get_params())
+    assert (tmp_path / "cp-symbol.json").exists()
+    assert (tmp_path / "cp-0001.params").exists()

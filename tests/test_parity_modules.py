@@ -141,6 +141,10 @@ def test_pcc_metric_matches_binary_mcc():
     m2.reset_local()
     assert np.isnan(m2.get()[1])
     assert abs(m2.get_global()[1] - 1.0) < 1e-12
+    # update after reset_local with FEWER classes must not crash
+    m2.update([mx.nd.array([0, 1.0])],
+              [mx.nd.array(np.eye(2).astype(np.float32))])
+    assert abs(m2.get()[1] - 1.0) < 1e-12
 
 
 def test_fused_rnn_initializer():

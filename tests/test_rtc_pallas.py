@@ -108,3 +108,19 @@ def test_pallas_flash_attention_non_pow2_block():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(attention(q, q, q)),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_flash_attention_cross_lengths():
+    """Cross-attention (Skv != Sq) works non-causally; causal rejects."""
+    from mxnet_tpu.parallel import attention
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.normal(size=(1, 2, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 2, 10, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 2, 10, 8)), jnp.float32)
+    got = mx.nd.pallas_flash_attention(q, k, v, block_q=2)
+    ref = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mx.nd.pallas_flash_attention(q, k, v, causal=True)
