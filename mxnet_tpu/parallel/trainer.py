@@ -247,8 +247,16 @@ class SPMDTrainer:
             "meta": {"step_num": jnp.asarray(step_num, jnp.int32),
                      "rng_key": rng_key},
         }
+        path = os.path.abspath(path)
+        if os.path.exists(path) and not os.path.exists(
+                os.path.join(path, "_CHECKPOINT_METADATA")):
+            # force=True rmtree's the target; only a PRIOR CHECKPOINT may
+            # be overwritten — never an unrelated user directory
+            raise ValueError(
+                "%s exists and is not an orbax checkpoint; refusing to "
+                "delete it" % path)
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(path), tree, force=True)
+        ckptr.save(path, tree, force=True)
         ckptr.wait_until_finished()
 
     def load_checkpoint_sharded(self, path):

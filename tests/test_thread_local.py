@@ -1,0 +1,79 @@
+"""Thread-local state isolation (reference:
+tests/python/unittest/test_thread_local.py: AttrScope, autograd recording
+state, and name manager must not leak across threads).
+"""
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_attr_scope_is_thread_local():
+    results = {}
+
+    def worker():
+        # the main thread's open AttrScope must NOT leak here
+        s = mx.sym.relu(mx.sym.Variable("t_a"))
+        results["worker_attr"] = s.attr("ctx_group")
+        with mx.AttrScope(ctx_group="worker_dev"):
+            s2 = mx.sym.relu(mx.sym.Variable("t_b"))
+        results["worker_scoped"] = s2.attr("ctx_group")
+
+    with mx.AttrScope(ctx_group="main_dev"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        s_main = mx.sym.relu(mx.sym.Variable("t_c"))
+    assert results["worker_attr"] is None
+    assert results["worker_scoped"] == "worker_dev"
+    assert s_main.attr("ctx_group") == "main_dev"
+
+
+def test_autograd_recording_is_thread_local():
+    flags = {}
+
+    def worker():
+        flags["recording_in_thread"] = autograd.is_recording()
+        flags["training_in_thread"] = autograd.is_training()
+
+    x = mx.nd.array(np.ones(3, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        assert autograd.is_recording()
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        y = (x * x).sum()
+    y.backward()
+    # the spawned thread saw a clean default state
+    assert flags["recording_in_thread"] is False
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones(3), rtol=1e-6)
+
+
+def test_parallel_eager_ops_threadsafe():
+    """Concurrent eager op dispatch from several threads must produce
+    correct independent results (the engine's thread-safety contract,
+    tests/nightly/test_tlocal_racecondition.py analog)."""
+    out = [None] * 4
+
+    def worker(i):
+        rng = np.random.RandomState(i)
+        a = mx.nd.array(rng.rand(32, 32).astype(np.float32))
+        r = a
+        for _ in range(5):
+            r = mx.nd.relu(mx.nd.dot(r, a.T) / 32.0)
+        out[i] = (a.asnumpy(), r.asnumpy())
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (a, r) in enumerate(out):
+        ref = a
+        for _ in range(5):
+            ref = np.maximum(ref @ a.T / 32.0, 0.0)
+        np.testing.assert_allclose(r, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg="thread %d" % i)
