@@ -248,7 +248,7 @@ class SPMDTrainer:
                      "rng_key": rng_key},
         }
         path = os.path.abspath(path)
-        if os.path.exists(path) and not os.path.exists(
+        if os.path.isdir(path) and os.listdir(path) and not os.path.exists(
                 os.path.join(path, "_CHECKPOINT_METADATA")):
             # force=True rmtree's the target; only a PRIOR CHECKPOINT may
             # be overwritten — never an unrelated user directory

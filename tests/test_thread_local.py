@@ -49,6 +49,7 @@ def test_autograd_recording_is_thread_local():
     y.backward()
     # the spawned thread saw a clean default state
     assert flags["recording_in_thread"] is False
+    assert flags["training_in_thread"] is False
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones(3), rtol=1e-6)
 
 
