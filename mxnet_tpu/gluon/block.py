@@ -784,6 +784,12 @@ class SymbolBlock(HybridBlock):
             if name in self._input_names:
                 continue
             (aux_vals if _is_aux_name(name) else arg_vals)[name] = p.data()
+        if autograd.is_recording():
+            # An imported model must stay trainable: the executor path runs
+            # its jitted program outside the tape (grad_req="null"), which
+            # would silently zero all gradients.  Record the whole graph as
+            # one tape node instead, like _CachedGraph does for CachedOp.
+            return self._taped_forward(inputs, arg_vals, aux_vals)
         if self._executor is None:
             # ONE bound executor for the block's lifetime: its internal
             # (training, config-epoch)-keyed jit cache makes repeat calls
@@ -811,6 +817,61 @@ class SymbolBlock(HybridBlock):
         if isinstance(out, (list, tuple)) and len(out) == 1:
             return out[0]
         return out
+
+    def _taped_forward(self, inputs, arg_vals, aux_vals):
+        """Run the symbol graph under the autograd tape.
+
+        One node for the whole graph, vjp = jax.vjp through the jitted
+        symbol evaluation (the CachedOp-backward analog,
+        src/imperative/cached_op.cc) — gradients flow both into this
+        block's Parameters and through the inputs to upstream recorded ops.
+        """
+        from .. import config as _config
+        from .. import random as _random
+        from ..symbol.symbol import _eval_symbol
+        training = autograd.is_training()
+        names = list(inputs.keys()) + list(arg_vals.keys())
+        nds = list(inputs.values()) + list(arg_vals.values())
+        cache_key = (training, _config.epoch())
+        if getattr(self, "_taped_cache", None) is None:
+            self._taped_cache = {}
+        if cache_key not in self._taped_cache:
+            self._taped_cache = {k: v for k, v in self._taped_cache.items()
+                                 if k[1] == cache_key[1]}
+            sym = self._output_sym
+
+            def pure(vals, aux_env, key, _names=tuple(names)):
+                env = dict(zip(_names, vals))
+                env.update(aux_env)
+                aux_updates = {}
+                with _random.trace_key_scope(key):
+                    outs = _eval_symbol(sym, env, training, aux_updates)
+                return tuple(outs), aux_updates
+
+            self._taped_cache[cache_key] = jax.jit(pure)
+        jitted = self._taped_cache[cache_key]
+        aux_env = {n: v._data for n, v in aux_vals.items()}
+        key = _random.new_eager_seed_key()
+        out_vals, vjp, aux_updates = jax.vjp(
+            lambda vals: jitted(vals, aux_env, key),
+            tuple(v._data for v in nds), has_aux=True)
+        outs = [_wrap(v) for v in out_vals]
+
+        def vjp_fn(cotangents, _vjp=vjp):
+            from ..ops.registry import _float0_to_none
+            (cts,) = _vjp(tuple(cotangents))
+            return tuple(_float0_to_none(c) for c in cts)
+
+        _tape.record_node(nds, outs, vjp_fn,
+                          name="SymbolBlock(%s)" % self.name)
+        if training:
+            with autograd.pause():
+                for n, v in aux_updates.items():
+                    if n in self.params._params:
+                        self.params._params[n].data()._data = v
+        if len(outs) == 1:
+            return outs[0]
+        return outs
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError

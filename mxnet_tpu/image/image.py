@@ -366,8 +366,14 @@ class ImageIter(DataIter):
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imgrec=None, data_name="data", label_name="softmax_label",
-                 last_batch_handle="pad", **kwargs):
+                 last_batch_handle="pad", seed=None, **kwargs):
         super().__init__(batch_size)
+        # seed controls shuffle determinism (reference ImageRecordIter's
+        # `seed` param); a private Random keeps it isolated from the global
+        # stream so two seeded iterators are independently reproducible.
+        self._shuffle_rng = _pyrandom.Random(seed) if seed is not None \
+            else _pyrandom
+        self._last_batch_handle = last_batch_handle
         assert path_imgrec or path_imglist or imgrec
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
@@ -437,7 +443,7 @@ class ImageIter(DataIter):
 
     def reset(self):
         if self.shuffle:
-            _pyrandom.shuffle(self._order)
+            self._shuffle_rng.shuffle(self._order)
         self.cur = 0
 
     def _read_sample(self, i):
@@ -463,6 +469,9 @@ class ImageIter(DataIter):
         (io.ImageDetRecordIter)."""
         n = len(self._keys)
         if self.cur >= n:
+            raise StopIteration
+        if self._last_batch_handle == "discard" and n - self.cur < \
+                self.batch_size:
             raise StopIteration
         out = []
         pad = 0

@@ -569,7 +569,11 @@ def ImageRecordIter(path_imgrec, batch_size, data_shape, **kwargs):
     """
     from .image import ImageIter
     for ignored in ("preprocess_threads", "prefetch_buffer", "verify_decode",
-                    "num_backup_threads", "seed", "round_batch"):
+                    "num_backup_threads"):
         kwargs.pop(ignored, None)
+    if not kwargs.pop("round_batch", True):
+        # round_batch=False changes partial-batch semantics (discard vs
+        # roll-over); honor it rather than silently altering epoch behavior
+        kwargs.setdefault("last_batch_handle", "discard")
     return ImageIter(batch_size=batch_size, data_shape=data_shape,
                      path_imgrec=path_imgrec, **kwargs)

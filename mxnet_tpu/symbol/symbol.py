@@ -790,8 +790,14 @@ class Executor:
         forward + backward fuse into a single XLA executable (replacing the
         reference's separate backward graph executor,
         src/executor/graph_executor.cc:91)."""
-        key_sig = tuple(wrt)
+        from .. import config as _config
+        key_sig = (tuple(wrt), _config.epoch())  # knobs bake in at trace
         if key_sig not in self._bwd_cache:
+            # evict programs compiled under superseded knob epochs (same
+            # invalidation contract as _fwd_fn: a config.set between calls
+            # must retrace the fused fwd+bwd program too)
+            self._bwd_cache = {k: v for k, v in self._bwd_cache.items()
+                               if k[1] == key_sig[1]}
             sym = self._symbol
 
             def run(wrt_vals, rest_env, cts, key):

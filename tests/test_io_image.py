@@ -181,3 +181,33 @@ def test_imageiter_rejects_unknown_kwargs(tmp_path):
     with pytest.raises(TypeError):
         mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
                            path_imgrec=rec, rand_cropp=True)
+
+
+def test_imagerecorditer_seed_and_round_batch(tmp_path):
+    """mx.io.ImageRecordIter honors `seed` (deterministic shuffle order —
+    reference ImageRecordIter seed param) and `round_batch=False`
+    (partial final batch discarded instead of wrap-padded, reference
+    round_batch semantics) instead of silently dropping them."""
+    rec = _make_rec_dataset(tmp_path, n=10)
+
+    def order(seed):
+        it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=2,
+                                   data_shape=(3, 16, 16), shuffle=True,
+                                   seed=seed)
+        out = []
+        for b in it:
+            out.extend(np.asarray(b.label[0].asnumpy()).tolist())
+        return out
+
+    a, b = order(7), order(7)
+    assert a == b, "same seed must give the same shuffle order"
+    assert order(8) != a or order(9) != a, "different seeds never differ"
+
+    # 10 samples / batch 4: round_batch=True pads to 3 batches, False
+    # discards the short one
+    it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=4,
+                               data_shape=(3, 16, 16))
+    assert sum(1 for _ in it) == 3
+    it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=4,
+                               data_shape=(3, 16, 16), round_batch=False)
+    assert sum(1 for _ in it) == 2

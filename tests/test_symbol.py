@@ -222,3 +222,52 @@ def test_nd_out_kwarg_honored():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         mx.nd.relu(x, out=mx.nd.zeros((5,)))
+
+
+def test_symbol_block_is_trainable(tmp_path):
+    """Fine-tuning an imported model (reference contract: SymbolBlock
+    supports backward, gluon/block.py:1190): recorded forward routes through
+    the tape, so loss.backward() fills parameter gradients AND chains
+    through the input to upstream recorded ops.  Gradients must match the
+    original exporting network's gradients exactly."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.Activation("relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).normal(
+        size=(5, 8)).astype(np.float32))
+    net(x)
+    prefix = str(tmp_path / "ft")
+    net.export(prefix)
+    re = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    re.collect_params().setattr("grad_req", "write")
+
+    # gradients from the original net
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    ref_grads = {p.name.split("_", 1)[-1]: p.grad().asnumpy()
+                 for p in net.collect_params().values()}
+
+    xin = x.copy()
+    xin.attach_grad()
+    with autograd.record():
+        loss = (re(xin) ** 2).sum()
+    loss.backward()
+    got_any = False
+    for name, p in re.params.items():
+        if p.grad_req == "null":
+            continue
+        g = p.grad().asnumpy()
+        assert g.any(), "zero gradient for imported param %s" % name
+        got_any = True
+        suffix = name.split("_", 1)[-1]
+        for rname, rg in ref_grads.items():
+            if rname.endswith(suffix) and rg.shape == g.shape:
+                np.testing.assert_allclose(g, rg, rtol=1e-5, atol=1e-6)
+    assert got_any
+    # the chain extends upstream through the block's input
+    assert xin.grad.asnumpy().any(), "no gradient flowed to the input"
