@@ -169,7 +169,21 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
             return
         if key in cotan:
             prev = cotan[key]
-            cotan[key] = prev + val if create_graph else jnp.add(prev, val)
+            from .ndarray.sparse import RowSparseTangent
+            if isinstance(prev, RowSparseTangent) or \
+                    isinstance(val, RowSparseTangent):
+                if isinstance(prev, RowSparseTangent) and \
+                        isinstance(val, RowSparseTangent):
+                    # sparse + sparse: concatenation IS the sum (duplicate
+                    # rows are combined at consumption time)
+                    cotan[key] = prev.concat(val)
+                else:
+                    sp, dn = (prev, val) if isinstance(
+                        prev, RowSparseTangent) else (val, prev)
+                    dn = dn._data if hasattr(dn, "_data") else dn
+                    cotan[key] = jnp.add(sp.densify(), dn)
+            else:
+                cotan[key] = prev + val if create_graph else jnp.add(prev, val)
         else:
             cotan[key] = val
 
@@ -195,11 +209,17 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
         if all(c is None for c in out_cts):
             continue
         # fill zeros for missing output cotangents (vjp needs a full tuple)
+        from .ndarray.sparse import RowSparseTangent
         filled = []
         for arr, c in zip(node.outputs, out_cts):
             if c is None:
                 z = jnp.zeros(arr.shape, arr._data.dtype)
                 filled.append(_wrap(z) if create_graph else z)
+            elif isinstance(c, RowSparseTangent):
+                # a sparse cotangent reaching a generic vjp densifies at the
+                # boundary (only the Embedding-weight leaf consumes sparse)
+                d = c.densify()
+                filled.append(_wrap(d) if create_graph else d)
             else:
                 filled.append(c)
         if create_graph and node.primal_fn is not None:
@@ -239,12 +259,30 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
 
     cotan, leaf_by_id = _reverse_walk(outputs, head_grads, retain_graph,
                                       create_graph=False)
+    from .ndarray.sparse import (RowSparseTangent, RowSparseNDArray,
+                                 _dedupe_rows)
     for arr in leaf_by_id.values():
         g = cotan.get(("leaf", id(arr)))
         if g is None:
             continue
         if arr._grad is None:
             continue  # marked with grad_req='null'
+        if isinstance(g, RowSparseTangent):
+            if isinstance(arr._grad, RowSparseNDArray):
+                # sparse grad buffer (Parameter grad_stype="row_sparse"):
+                # only the touched rows are ever stored
+                if arr._grad_req == "add":
+                    arr._grad._refresh_sparse()
+                    idx = jnp.concatenate([arr._grad._indices, g.indices])
+                    vals = jnp.concatenate([
+                        jnp.reshape(arr._grad._values,
+                                    (-1,) + g.values.shape[1:]),
+                        g.values])
+                    arr._grad._set_rows(*_dedupe_rows(idx, vals))
+                else:
+                    arr._grad._set_rows(*_dedupe_rows(g.indices, g.values))
+                continue
+            g = g.densify()
         if arr._grad_req == "add":
             arr._grad._data = jnp.add(arr._grad._data, g)
         else:
@@ -329,9 +367,14 @@ def grad_arrays(outputs, variables, head_grads=None, retain_graph=False,
     finally:
         if prev_rec is not None:
             set_recording(prev_rec)
+    from .ndarray.sparse import (RowSparseTangent, RowSparseNDArray,
+                                 _dedupe_rows)
     results = []
     for v in variables:
         ct = cotan.get(("leaf", id(v)))
+        if isinstance(ct, RowSparseTangent):
+            idx, vals = _dedupe_rows(ct.indices, ct.values)
+            ct = RowSparseNDArray(vals, idx, ct.shape)
         results.append(None if ct is None
                        else (ct if hasattr(ct, "_data") else _wrap(ct)))
     return results

@@ -203,7 +203,18 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = _wrap(jnp.zeros(self._data.shape, self._data._data.dtype))
+        if self._grad_stype == "row_sparse":
+            # O(rows-touched) gradient buffer: starts with zero live rows;
+            # backward writes only the touched rows (reference: row_sparse
+            # grad of Embedding(sparse_grad=True), indexing_op.cc)
+            from ..ndarray.sparse import RowSparseNDArray
+            shp = tuple(self._data.shape)
+            self._grad = RowSparseNDArray(
+                jnp.zeros((0,) + shp[1:], self._data._data.dtype),
+                jnp.zeros((0,), jnp.int32), shp)
+        else:
+            self._grad = _wrap(
+                jnp.zeros(self._data.shape, self._data._data.dtype))
         autograd.mark_variables([self._data], [self._grad], self.grad_req)
 
     def _reduce(self):
@@ -295,6 +306,14 @@ class Parameter:
 
     def zero_grad(self):
         if self._grad is None:
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(self._grad, RowSparseNDArray):
+            # back to zero live rows — never materializes the dense image
+            shp = self._grad._rs_shape
+            self._grad._set_rows(jnp.zeros((0,), jnp.int32),
+                                 jnp.zeros((0,) + shp[1:],
+                                           self._grad._values.dtype))
             return
         self._grad._data = jnp.zeros_like(self._grad._data)
 

@@ -177,6 +177,14 @@ class Trainer:
         if self._kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
+                    if not self._update_on_kvstore and \
+                            getattr(param, "_grad_stype", "default") == \
+                            "row_sparse" and \
+                            getattr(self._kvstore, "num_workers", 1) == 1:
+                        # single-worker reduce of a row_sparse grad is the
+                        # identity; the kvstore round trip would only build
+                        # the dense image the sparse path exists to avoid
+                        continue
                     if self._update_on_kvstore:
                         self._kvstore.pushpull(
                             i, param.grad(), out=param.data(), priority=-i)
@@ -209,12 +217,14 @@ class Trainer:
                 continue
             grad = param.grad()
             if getattr(param, "_grad_stype", "default") == "row_sparse":
-                # Embedding(sparse_grad=True) path: expose the tape's dense
-                # scatter-add gradient as row_sparse so the optimizer takes
-                # its lazy row update (reference trainer/kvstore row_sparse
-                # flow, python/mxnet/gluon/trainer.py:305+)
-                from ..ndarray.sparse import dense_to_sparse
-                grad = dense_to_sparse(grad, "row_sparse")
+                # Embedding(sparse_grad=True) path: the tape now writes the
+                # gradient as a lazy RowSparseNDArray (O(rows-touched), no
+                # dense image); convert only if a dense grad slipped in via
+                # a non-sparse-aware op (reference trainer/kvstore
+                # row_sparse flow, python/mxnet/gluon/trainer.py:305+)
+                from ..ndarray.sparse import RowSparseNDArray, dense_to_sparse
+                if not isinstance(grad, RowSparseNDArray):
+                    grad = dense_to_sparse(grad, "row_sparse")
             updater(i, grad, param.data())
 
     def save_states(self, fname):

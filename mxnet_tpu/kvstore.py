@@ -181,12 +181,18 @@ class KVStore:
         keys = key if isinstance(key, (list, tuple)) else [key] * len(outs)
         ids = row_ids if isinstance(row_ids, (list, tuple)) else \
             [row_ids] * len(outs)
+        from .ndarray.sparse import RowSparseNDArray
         for k, o, rid in zip(keys, outs, ids):
             stored = self._store[k]
             src = stored._data if hasattr(stored, "_data") else \
                 jnp.asarray(stored)
             rows = jnp.asarray(rid._data if hasattr(rid, "_data")
                                else rid).astype(jnp.int32).ravel()
+            if isinstance(o, RowSparseNDArray):
+                # sparse out: only the K requested rows are gathered and
+                # stored — no dense image is built on either side
+                o._set_rows(rows, src[rows].astype(o.dtype))
+                continue
             gathered = jnp.zeros_like(src).at[rows].set(src[rows])
             o._set_data(gathered.astype(o._data.dtype)) \
                 if hasattr(o, "_set_data") else setattr(o, "_data", gathered)

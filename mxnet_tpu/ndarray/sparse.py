@@ -2,11 +2,15 @@
 
 Reference: include/mxnet/ndarray.h:61-65 storage types, src/operator/tensor
 sparse kernels, kvstore row_sparse pull.  TPU-native: XLA has no native sparse
-layout; row_sparse is represented as (indices, values) pairs and csr via
-jax.experimental.sparse BCSR where available.  Ops densify at the boundary —
-the capability (API + semantics) is preserved, the TPU execution is dense
-gather/scatter, which on MXU-class hardware is usually *faster* than true
-sparse math at deep-learning densities.
+layout; row_sparse is represented as (indices, values) pairs that stay in that
+computational form end-to-end — the Embedding(sparse_grad=True) gradient, the
+optimizer's lazy row update (optimizer.py:524 analog) and row_sparse_pull
+(src/kvstore/kvstore_dist.h:318 analog) all touch only the K live rows, so a
+10Mx512 embedding trains with O(rows-touched) extra memory exactly like the
+reference.  The dense image is materialized lazily ONLY when a dense op pulls
+``._data`` — on MXU-class hardware dense gather/scatter on the live rows beats
+true sparse math at deep-learning densities, so that boundary is the
+performance-correct one.
 """
 from __future__ import annotations
 
@@ -19,18 +23,140 @@ __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
            "dense_to_sparse", "zeros"]
 
 
-class RowSparseNDArray(NDArray):
-    """Rows-subset sparse array: (indices[K], values[K, ...cols])."""
+def _live_rows(d):
+    """(indices, values) of the nonzero rows of a dense image.  Eager-only:
+    the row count crosses to host to fix the output shape."""
+    alive = jnp.any(d.reshape(d.shape[0], -1) != 0, axis=1)
+    nz = _np.where(_np.asarray(alive))[0]
+    idx = jnp.asarray(nz.astype(_np.int32))
+    return idx, d[idx]
 
-    __slots__ = ("_indices", "_values")
+
+def _dedupe_rows(indices, values):
+    """Sum duplicate row contributions (eager-only: dynamic output shape).
+
+    The scatter-add semantics of a row_sparse gradient with repeated ids —
+    the reference dedupes identically when converting grads
+    (src/operator/tensor/sparse_retain-inl.h / kvstore unique merge).
+    """
+    idx_np = _np.asarray(indices)
+    uniq, inv = _np.unique(idx_np, return_inverse=True)
+    if uniq.shape[0] == idx_np.shape[0]:
+        # already unique; keep sorted order for reference parity
+        order = _np.argsort(idx_np, kind="stable")
+        return (jnp.asarray(idx_np[order].astype(_np.int32)),
+                jnp.asarray(values)[jnp.asarray(order)])
+    out = jnp.zeros((uniq.shape[0],) + tuple(values.shape[1:]), values.dtype)
+    out = out.at[jnp.asarray(inv)].add(jnp.asarray(values))
+    return jnp.asarray(uniq.astype(_np.int32)), out
+
+
+class RowSparseTangent:
+    """A row_sparse cotangent flowing through the autograd tape.
+
+    (indices[K], values[K, cols], shape) — produced by ops registered with a
+    ``sparse_vjp`` (Embedding with sparse_grad=True) and consumed by the
+    tape's leaf-gradient write.  May hold duplicate indices; consumers that
+    need set-semantics dedupe via ``_dedupe_rows``.
+    """
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = jnp.asarray(indices).astype(jnp.int32).ravel()
+        self.values = jnp.asarray(values)
+        self.shape = tuple(shape)
+
+    def densify(self):
+        return jnp.zeros(self.shape, self.values.dtype).at[
+            self.indices].add(self.values)
+
+    def concat(self, other):
+        assert self.shape == other.shape
+        return RowSparseTangent(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+
+class RowSparseNDArray(NDArray):
+    """Rows-subset sparse array: (indices[K], values[K, ...cols]).
+
+    LAZY: construction never materializes the dense image; ``._data`` (the
+    dense view any dense op reads) is built on first access and cached.
+    Writing ``._data`` (dense mutation) keeps the array consistent by
+    re-deriving the sparse fields on next sparse access.
+    """
+
+    __slots__ = ("_indices", "_values", "_rs_shape", "_dense_cache",
+                 "_sparse_stale")
 
     def __init__(self, values, indices, shape):
         vals = jnp.asarray(values)
-        idx = jnp.asarray(indices).astype(jnp.int64 if False else jnp.int32)
-        dense = jnp.zeros(shape, vals.dtype).at[idx].set(vals)
-        super().__init__(dense)
+        idx = jnp.asarray(indices).astype(jnp.int32).ravel()
+        if shape is None:
+            raise ValueError("row_sparse requires an explicit shape")
         self._indices = idx
         self._values = vals
+        self._rs_shape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._sparse_stale = False
+        # NDArray handle state (bypass NDArray._init: it writes ._data,
+        # which for this class means materializing the dense image)
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_node = None
+        self._tape_index = 0
+        self._is_leaf = False
+
+    # -------------------------------------------------------- lazy plumbing
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = jnp.zeros(
+                self._rs_shape, self._values.dtype).at[self._indices].set(
+                    self._values)
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, new):
+        new = jnp.asarray(new)
+        self._dense_cache = new
+        self._rs_shape = tuple(int(s) for s in new.shape)
+        self._sparse_stale = True
+
+    def _refresh_sparse(self):
+        if self._sparse_stale:
+            self._indices, self._values = _live_rows(self._dense_cache)
+            self._sparse_stale = False
+
+    def _set_rows(self, indices, values):
+        """Replace content with the given rows (no dense materialization)."""
+        self._indices = jnp.asarray(indices).astype(jnp.int32).ravel()
+        self._values = jnp.asarray(values)
+        self._dense_cache = None
+        self._sparse_stale = False
+
+    # ------------------------------------------------------------- metadata
+    # (overridden so metadata reads never force the dense image)
+    @property
+    def shape(self):
+        return self._rs_shape
+
+    @property
+    def dtype(self):
+        src = self._dense_cache if self._sparse_stale else self._values
+        return _np.dtype(src.dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._rs_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._rs_shape)
 
     @property
     def stype(self):
@@ -38,10 +164,12 @@ class RowSparseNDArray(NDArray):
 
     @property
     def indices(self):
+        self._refresh_sparse()
         return _wrap(self._indices)
 
     @property
     def data(self):
+        self._refresh_sparse()
         return _wrap(self._values)
 
     def tostype(self, stype):
@@ -53,22 +181,68 @@ class RowSparseNDArray(NDArray):
 
 
 class CSRNDArray(NDArray):
-    __slots__ = ("_indptr", "_indices_csr", "_values")
+    """Compressed-sparse-row 2-D array — lazy like RowSparseNDArray: the
+    dense image is built (vectorized scatter, not a Python row loop) only
+    when a dense op reads ``._data``."""
+
+    __slots__ = ("_indptr", "_indices_csr", "_values", "_rs_shape",
+                 "_dense_cache")
 
     def __init__(self, data, indptr, indices, shape):
-        vals = jnp.asarray(data)
-        indptr = jnp.asarray(indptr).astype(jnp.int32)
-        idx = jnp.asarray(indices).astype(jnp.int32)
-        dense = _np.zeros(shape, dtype=_np.asarray(vals).dtype)
-        ip = _np.asarray(indptr)
-        ii = _np.asarray(idx)
-        vv = _np.asarray(vals)
-        for r in range(shape[0]):
-            dense[r, ii[ip[r]:ip[r + 1]]] = vv[ip[r]:ip[r + 1]]
-        super().__init__(dense)
-        self._indptr = indptr
-        self._indices_csr = idx
-        self._values = vals
+        self._values = jnp.asarray(data)
+        self._indptr = jnp.asarray(indptr).astype(jnp.int32)
+        self._indices_csr = jnp.asarray(indices).astype(jnp.int32)
+        self._rs_shape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_node = None
+        self._tape_index = 0
+        self._is_leaf = False
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            ip = _np.asarray(self._indptr)
+            rows = _np.repeat(_np.arange(len(ip) - 1), _np.diff(ip))
+            self._dense_cache = jnp.zeros(
+                self._rs_shape, self._values.dtype).at[
+                    jnp.asarray(rows.astype(_np.int32)),
+                    self._indices_csr].set(self._values)
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, new):
+        # dense write-through: re-derive the csr triple eagerly (rare path —
+        # csr arrays are read-mostly iterator outputs)
+        a = _np.asarray(new)
+        self._rs_shape = tuple(a.shape)
+        rr, cc = _np.nonzero(a)
+        counts = _np.bincount(rr, minlength=a.shape[0])
+        self._indptr = jnp.asarray(
+            _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int32))
+        self._indices_csr = jnp.asarray(cc.astype(_np.int32))
+        self._values = jnp.asarray(a[rr, cc])
+        self._dense_cache = jnp.asarray(new)
+
+    @property
+    def shape(self):
+        return self._rs_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._rs_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._rs_shape)
 
     @property
     def stype(self):
@@ -116,9 +290,8 @@ def dense_to_sparse(arr: NDArray, stype: str):
         # host (to fix the row count); values are gathered with jnp — no
         # full-tensor transfer on the sparse-grad training path
         d = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
-        alive = jnp.any(d.reshape(d.shape[0], -1) != 0, axis=1)
-        nz = _np.where(_np.asarray(alive))[0]
-        return RowSparseNDArray(d[nz], nz, d.shape)
+        idx, vals = _live_rows(d)
+        return RowSparseNDArray(vals, idx, d.shape)
     a = arr.asnumpy()
     if stype == "csr":
         if a.ndim != 2:
@@ -165,6 +338,7 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         return _wrap(mat @ r)
     if isinstance(lhs, RowSparseNDArray) and not transpose_a:
         # rows-subset times dense: gather live rows, small matmul, scatter
+        lhs._refresh_sparse()
         r = _raw(rhs)
         if transpose_b:
             r = r.T
@@ -186,8 +360,26 @@ def retain(data, indices):
     idx = jnp.asarray(indices._data if isinstance(indices, NDArray)
                       else indices).astype(jnp.int32).ravel()
     if isinstance(data, RowSparseNDArray):
-        src = data._data
-    else:
-        src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        # look the requested ids up among the live rows — absent ids yield
+        # zero rows; the dense image is never built
+        data._refresh_sparse()
+        src_idx = _np.asarray(data._indices)
+        # live indices are not guaranteed sorted (construction and
+        # _set_rows keep caller order); searchsorted needs sorted keys
+        order = _np.argsort(src_idx, kind="stable")
+        src_idx = src_idx[order]
+        src_vals = data._values[jnp.asarray(order.astype(_np.int32))]
+        req = _np.asarray(idx)
+        pos = _np.searchsorted(src_idx, req)
+        posc = _np.clip(pos, 0, max(len(src_idx) - 1, 0))
+        hit = (pos < len(src_idx)) & (src_idx[posc] == req) \
+            if len(src_idx) else _np.zeros(len(req), bool)
+        gathered = src_vals[jnp.asarray(posc.astype(_np.int32))] if \
+            len(src_idx) else jnp.zeros((len(req),) + data._rs_shape[1:],
+                                        data._values.dtype)
+        mask = jnp.asarray(hit).reshape((-1,) + (1,) * (gathered.ndim - 1))
+        vals = jnp.where(mask, gathered, 0)
+        return RowSparseNDArray(vals, idx, data.shape)
+    src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
     vals = src[idx]
     return RowSparseNDArray(vals, idx, src.shape)

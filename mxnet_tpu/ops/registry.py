@@ -36,13 +36,17 @@ class Operator:
     aliases : extra registry names.
     """
 
-    __slots__ = ("name", "fn", "differentiable", "num_outputs")
+    __slots__ = ("name", "fn", "differentiable", "num_outputs", "sparse_vjp")
 
     def __init__(self, name, fn, differentiable=True, num_outputs=1):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
         self.num_outputs = num_outputs
+        # optional (in_arrays, attrs, cotangents) -> per-NDArray-input cts
+        # hook producing sparse cotangents (RowSparseTangent) instead of the
+        # generic jax.vjp; active when the call passes sparse_grad=True
+        self.sparse_vjp = None
 
 
 def register(name, differentiable=True, num_outputs=1, aliases=()):
@@ -98,6 +102,27 @@ def apply_op(op, *inputs, **attrs):
             in_arrays.append(x)
 
     recording = _tape.is_recording() and op.differentiable and nd_inputs
+
+    if recording and op.sparse_vjp is not None and attrs.get("sparse_grad"):
+        # sparse-cotangent path (Embedding sparse_grad=True): the weight
+        # gradient stays (rows, values) — never a dense scatter-add image —
+        # so huge embeddings train with O(rows-touched) gradient memory
+        # (reference: src/operator/tensor/indexing_op.cc row_sparse grad)
+        out_vals = op.fn(*in_arrays, **attrs)
+        multi = isinstance(out_vals, (tuple, list))
+        outs = [_wrap(v) for v in (out_vals if multi else (out_vals,))]
+        # the hook returns one cotangent per *positional* input; the tape
+        # node records only the NDArray inputs, so select those positions
+        # (same alignment the generic path gets via nd_idx)
+        nd_pos = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+
+        def sparse_vjp_fn(cotangents, _op=op, _in=tuple(in_arrays),
+                          _attrs=dict(attrs), _nd_pos=tuple(nd_pos)):
+            cts = _op.sparse_vjp(_in, _attrs, cotangents)
+            return tuple(cts[i] for i in _nd_pos)
+
+        _tape.record_node(nd_inputs, outs, sparse_vjp_fn, name=op.name)
+        return outs if multi else outs[0]
 
     if recording:
         nd_idx = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]

@@ -362,6 +362,24 @@ def _embedding(data, weight, input_dim=None, output_dim=None, **_):
     return jnp.take(weight, idx, axis=0)
 
 
+def _embedding_sparse_vjp(in_arrays, attrs, cotangents):
+    """Row-sparse weight gradient for Embedding(sparse_grad=True): the
+    cotangent rows keyed by the looked-up ids, no dense scatter image
+    (reference: src/operator/tensor/indexing_op.cc EmbeddingOpBackward
+    row_sparse output).  Returns (d_data, d_weight) for the two NDArray
+    inputs; ids are integers so d_data is None."""
+    from ..ndarray.sparse import RowSparseTangent
+    data, weight = in_arrays[0], in_arrays[1]
+    (ct,) = cotangents if len(cotangents) == 1 else (cotangents[0],)
+    ids = jnp.asarray(data).astype(jnp.int32).ravel()
+    vals = jnp.reshape(ct, (ids.shape[0], -1))
+    return (None, RowSparseTangent(ids, vals, weight.shape))
+
+
+from .registry import get as _get_op  # noqa: E402
+_get_op("Embedding").sparse_vjp = _embedding_sparse_vjp
+
+
 @register("one_hot", differentiable=False)
 def _one_hot(a, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **_):
     from ..base import dtype_np

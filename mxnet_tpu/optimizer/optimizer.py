@@ -197,6 +197,7 @@ class Optimizer:
             # lazy sparse update (reference optimizer.py:524+): ONLY the
             # rows present in the gradient are touched — stale rows see no
             # weight decay and no momentum decay
+            grad._refresh_sparse()
             rows = grad._indices
             vals = self._preprocess_grad(grad._values)
             new_w, new_state = self.step_rows(
