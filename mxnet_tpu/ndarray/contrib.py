@@ -8,6 +8,13 @@ name first (quantized ops and friends register both spellings), then the
 from __future__ import annotations
 
 from ..ops import registry as _registry
+# Container-level graph ops (CSRNDArray in/out — host-side sampling, the
+# reference's CPU-only FComputeEx pattern); module attributes take
+# precedence over the registry __getattr__ below.
+from .dgl import (dgl_csr_neighbor_uniform_sample,  # noqa: F401
+                  dgl_csr_neighbor_non_uniform_sample,  # noqa: F401
+                  dgl_subgraph, dgl_graph_compact,  # noqa: F401
+                  dgl_adjacency)  # noqa: F401
 
 
 def _resolve(name):

@@ -1,0 +1,244 @@
+"""DGL graph ops: neighbor sampling, induced subgraphs, compaction.
+
+Reference: src/operator/contrib/dgl_graph.cc (_contrib_dgl_csr_neighbor_
+uniform_sample, _contrib_dgl_csr_neighbor_non_uniform_sample,
+_contrib_dgl_subgraph, _contrib_dgl_graph_compact, _contrib_dgl_adjacency).
+
+TPU-native design: these are GRAPH-SAMPLING data-pipeline ops — pointer
+chasing over CSR structure with data-dependent output sizes, exactly the
+shape of work that belongs on the host feeding the device, not inside an
+XLA program (the reference likewise runs them as CPU-only FComputeEx
+kernels).  They operate on the CSRNDArray container with numpy and return
+fixed-size (max_num_vertices-padded) containers so downstream device code
+sees static shapes.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, _wrap
+from .sparse import CSRNDArray
+
+__all__ = ["dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample",
+           "dgl_subgraph", "dgl_graph_compact", "dgl_adjacency"]
+
+
+def _csr_parts(csr):
+    assert isinstance(csr, CSRNDArray), "expects a CSRNDArray graph"
+    return (_np.asarray(csr._indptr), _np.asarray(csr._indices_csr),
+            _np.asarray(csr._values), csr.shape)
+
+
+def _as_np(x):
+    return _np.asarray(x._data if isinstance(x, NDArray) else x)
+
+
+def _sample_one(indptr, indices, data, shape, seed, prob, num_hops,
+                num_neighbor, max_num_vertices):
+    """BFS neighbor sampling from `seed`, up to num_neighbor neighbors per
+    vertex per hop; returns (verts, layer, sub_csr_parts)."""
+    rng = _np.random
+    seed = _np.asarray(seed, _np.int64).ravel()
+    picked = {}                      # vertex -> hop layer
+    frontier = []
+    for v in seed:
+        if int(v) >= 0 and int(v) not in picked:
+            picked[int(v)] = 0
+            frontier.append(int(v))
+    edges = {}                       # (src, dst) -> edge id/value
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for u in frontier:
+            row = indices[indptr[u]:indptr[u + 1]]
+            vals = data[indptr[u]:indptr[u + 1]]
+            if len(row) == 0:
+                continue
+            k = min(num_neighbor, len(row))
+            if prob is None:
+                sel = rng.choice(len(row), size=k, replace=False)
+            else:
+                p = _np.asarray(prob, _np.float64)[row]
+                s = p.sum()
+                if s <= 0:
+                    continue
+                # can only draw as many distinct neighbors as have
+                # positive probability
+                k = min(k, int(_np.count_nonzero(p)))
+                sel = rng.choice(len(row), size=k, replace=False, p=p / s)
+            for j in sel:
+                v = int(row[j])
+                edges[(u, v)] = vals[j]
+                if v not in picked and len(picked) < max_num_vertices:
+                    picked[v] = hop
+                    nxt.append(v)
+        frontier = nxt
+    verts = _np.asarray(sorted(picked), _np.int64)[:max_num_vertices]
+    vset = set(verts.tolist())
+    layer = _np.zeros(max_num_vertices, _np.int64)
+    for i, v in enumerate(verts):
+        layer[i] = picked[int(v)]
+    out_verts = _np.zeros(max_num_vertices + 1, _np.int64)
+    out_verts[:len(verts)] = verts
+    out_verts[-1] = len(verts)
+    # sub-csr: row = slot of the source vertex in `verts`, col = ORIGINAL
+    # vertex id, data = original edge id.  graph_compact() strips the
+    # padding rows and remaps the columns.
+    slot = {int(v): i for i, v in enumerate(verts)}
+    rows, cols, vals = [], [], []
+    for (u, v), eid in sorted(edges.items()):
+        if u in vset and v in vset:
+            rows.append(slot[u])
+            cols.append(v)
+            vals.append(eid)
+    order = _np.lexsort((cols, rows)) if rows else _np.asarray([], _np.int64)
+    rows = _np.asarray(rows, _np.int64)[order]
+    cols = _np.asarray(cols, _np.int64)[order]
+    vals = _np.asarray(vals)[order]
+    counts = _np.bincount(rows, minlength=max_num_vertices)
+    sub_indptr = _np.concatenate([[0], _np.cumsum(counts)])
+    return out_verts, layer, (sub_indptr, cols, vals,
+                              (max_num_vertices, shape[1]))
+
+
+def _sample_many(csr, seeds, prob, num_hops, num_neighbor,
+                 max_num_vertices, **_):
+    indptr, indices, data, shape = _csr_parts(csr)
+    outs = []
+    per_seed = []
+    for seed in seeds:
+        v, layer, (ip, ci, vv, shp) = _sample_one(
+            indptr, indices, data, shape, _as_np(seed), prob,
+            int(num_hops), int(num_neighbor), int(max_num_vertices))
+        per_seed.append((v, CSRNDArray(vv, ip, ci, shp), layer))
+    # reference output order: all vertex arrays, all csrs, all layers
+    outs.extend(_wrap(_np_to_jnp(v)) for v, _, _ in per_seed)
+    outs.extend(c for _, c, _ in per_seed)
+    outs.extend(_wrap(_np_to_jnp(l)) for _, _, l in per_seed)
+    return outs
+
+
+def _np_to_jnp(a):
+    import jax.numpy as jnp
+    return jnp.asarray(a.astype(_np.int32))
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=None, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    **kw):
+    """Uniform neighbor sampling (reference dgl_graph.cc:745): per seed
+    array returns (sampled_vertices[max+1, last=count], sampled CSR with
+    original edge ids, layer[max])."""
+    return _sample_many(csr, seeds, None, num_hops, num_neighbor,
+                        max_num_vertices)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100, **kw):
+    """Probability-weighted neighbor sampling (reference dgl_graph.cc:839);
+    outputs add a per-vertex probability array after the vertex arrays."""
+    p = _as_np(probability).astype(_np.float64)
+    indptr, indices, data, shape = _csr_parts(csr)
+    verts_out, csr_out, prob_out, layer_out = [], [], [], []
+    for seed in seeds:
+        v, layer, (ip, ci, vv, shp) = _sample_one(
+            indptr, indices, data, shape, _as_np(seed), p,
+            int(num_hops), int(num_neighbor), int(max_num_vertices))
+        n = int(v[-1])
+        import jax.numpy as jnp
+        pv = _np.zeros(int(max_num_vertices), _np.float32)
+        pv[:n] = p[v[:n]]
+        verts_out.append(_wrap(_np_to_jnp(v)))
+        csr_out.append(CSRNDArray(vv, ip, ci, shp))
+        prob_out.append(_wrap(jnp.asarray(pv)))
+        layer_out.append(_wrap(_np_to_jnp(layer)))
+    return verts_out + csr_out + prob_out + layer_out
+
+
+def dgl_subgraph(graph, *vertex_sets, return_mapping=False, num_args=None,
+                 **kw):
+    """Induced subgraph per vertex set (reference dgl_graph.cc:1116): new
+    edge ids are 1..nnz row-major; with return_mapping the paired CSR holds
+    the parent's edge ids."""
+    indptr, indices, data, shape = _csr_parts(graph)
+    new_graphs, mappings = [], []
+    for vs in vertex_sets:
+        v = _as_np(vs).astype(_np.int64).ravel()
+        slot = {int(x): i for i, x in enumerate(v)}
+        n = len(v)
+        rows, cols, orig = [], [], []
+        for i, u in enumerate(v):
+            row = indices[indptr[u]:indptr[u + 1]]
+            vals = data[indptr[u]:indptr[u + 1]]
+            for j, w in enumerate(row):
+                if int(w) in slot:
+                    rows.append(i)
+                    cols.append(slot[int(w)])
+                    orig.append(vals[j])
+        order = _np.lexsort((cols, rows)) if rows else \
+            _np.asarray([], _np.int64)
+        rows = _np.asarray(rows, _np.int64)[order]
+        cols = _np.asarray(cols, _np.int64)[order]
+        orig = _np.asarray(orig)[order]
+        counts = _np.bincount(rows, minlength=n)
+        ip = _np.concatenate([[0], _np.cumsum(counts)])
+        new_ids = _np.arange(1, len(rows) + 1, dtype=orig.dtype
+                             if len(orig) else _np.int64)
+        new_graphs.append(CSRNDArray(new_ids, ip, cols, (n, n)))
+        mappings.append(CSRNDArray(orig, ip, cols, (n, n)))
+    if return_mapping:
+        return new_graphs + mappings
+    return new_graphs
+
+
+def dgl_graph_compact(*args, graph_sizes=(), return_mapping=False,
+                      num_args=None, **kw):
+    """Strip sampling padding (reference dgl_graph.cc:1551): inputs are N
+    sampled CSRs followed by their N vertex arrays; output CSRs are
+    (size, size) with columns remapped to vertex slots and edge ids
+    renumbered 1..nnz (mapping CSRs keep the originals)."""
+    n = len(args) // 2
+    graphs, varrays = args[:n], args[n:]
+    if isinstance(graph_sizes, (int, _np.integer)):
+        graph_sizes = (graph_sizes,) * n
+    new_graphs, mappings = [], []
+    for g, va, size in zip(graphs, varrays, graph_sizes):
+        indptr, indices, data, shape = _csr_parts(g)
+        v = _as_np(va).astype(_np.int64).ravel()[:int(size)]
+        slot = {int(x): i for i, x in enumerate(v)}
+        s = int(size)
+        rows, cols, orig = [], [], []
+        for i in range(min(s, len(indptr) - 1)):
+            row = indices[indptr[i]:indptr[i + 1]]
+            vals = data[indptr[i]:indptr[i + 1]]
+            for j, w in enumerate(row):
+                if int(w) in slot:
+                    rows.append(i)
+                    cols.append(slot[int(w)])
+                    orig.append(vals[j])
+        order = _np.lexsort((cols, rows)) if rows else \
+            _np.asarray([], _np.int64)
+        rows = _np.asarray(rows, _np.int64)[order]
+        cols = _np.asarray(cols, _np.int64)[order]
+        orig = _np.asarray(orig)[order]
+        counts = _np.bincount(rows, minlength=s)
+        ip = _np.concatenate([[0], _np.cumsum(counts)])
+        new_ids = _np.arange(1, len(rows) + 1,
+                             dtype=orig.dtype if len(orig) else _np.int64)
+        new_graphs.append(CSRNDArray(new_ids, ip, cols, (s, s)))
+        mappings.append(CSRNDArray(orig, ip, cols, (s, s)))
+    if return_mapping:
+        return new_graphs + mappings
+    if len(new_graphs) == 1:
+        return new_graphs[0]
+    return new_graphs
+
+
+def dgl_adjacency(csr, **kw):
+    """CSR graph -> adjacency with float32 ones (reference
+    dgl_graph.cc:1377)."""
+    indptr, indices, data, shape = _csr_parts(csr)
+    return CSRNDArray(_np.ones(len(indices), _np.float32), indptr, indices,
+                      shape)

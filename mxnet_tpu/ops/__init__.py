@@ -10,6 +10,8 @@ from . import random_ops  # noqa: F401  (registers _random_*/sample_* ops)
 from . import spatial  # noqa: F401  (registers sampler/warp/deformable ops)
 from . import signal  # noqa: F401  (registers fft/ifft)
 from . import optim_ops  # noqa: F401  (registers *_update optimizer ops)
+from . import misc  # noqa: F401  (registers scalar/legacy-alias/misc ops)
+from . import contrib_extra  # noqa: F401  (quantized/proposal/psroi/graph)
 from . import pallas_kernels  # noqa: F401  (registers pallas_* kernels)
 
 __all__ = ["Operator", "apply_op", "get", "invoke", "list_ops", "register"]

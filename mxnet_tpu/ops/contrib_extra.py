@@ -1,0 +1,638 @@
+"""Contrib operator completion: quantized graph ops, RPN proposals,
+position-sensitive ROI pooling, and assorted contrib math.
+
+Reference files are cited per op.  Same fixed-shape TPU design rules as
+ops/contrib.py: no dynamic output counts — suppressed/invalid entries are
+marked, not removed; per-ROI work is vmapped; box-region sums use integral
+images (cumsum) so every ROI costs O(1) gathers instead of a dynamic
+pixel loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import register, get, _REGISTRY
+from .contrib import _corner_iou, _nms_one
+
+__all__ = []
+
+
+# ---------------------------------------------------------- small math ops
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0, **_):
+    """a*x^2 + b*x + c (reference src/operator/contrib/quadratic_op.cc —
+    the tutorial op; kept for script parity)."""
+    x = jnp.asarray(data)
+    return a * x * x + b * x + c
+
+
+@register("_contrib_allclose", aliases=("allclose",), differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True, **_):
+    """1.0 iff allclose (reference contrib/allclose_op.cc)."""
+    ok = jnp.allclose(jnp.asarray(a), jnp.asarray(b), rtol=rtol, atol=atol,
+                      equal_nan=bool(equal_nan))
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+@register("_contrib_arange_like", aliases=("arange_like",),
+          differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
+    """arange shaped like data (reference contrib/tensor ops arange_like —
+    transformer position-id helper)."""
+    d = jnp.asarray(data)
+    if axis is None:
+        n = d.size
+        out = start + step * (jnp.arange(n) // repeat)
+        return out.reshape(d.shape).astype(d.dtype)
+    n = d.shape[axis]
+    out = (start + step * (jnp.arange(n) // repeat)).astype(d.dtype)
+    shape = [1] * d.ndim
+    shape[axis] = n
+    return jnp.broadcast_to(out.reshape(shape), d.shape)
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def _index_copy(old, index, new, **_):
+    """Copy new[i] into old[index[i]] (reference contrib/index_copy.cc)."""
+    idx = jnp.asarray(index).astype(jnp.int32).ravel()
+    return jnp.asarray(old).at[idx].set(jnp.asarray(new))
+
+
+@register("_contrib_index_array", aliases=("index_array",),
+          differentiable=False)
+def _index_array(data, axes=None, **_):
+    """Per-element N-d indices (reference contrib/index_array.cc): output
+    shape data.shape + (len(axes) or ndim,)."""
+    d = jnp.asarray(data)
+    ax = tuple(axes) if axes else tuple(range(d.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in d.shape], indexing="ij")
+    return jnp.stack([grids[a] for a in ax], axis=-1).astype(jnp.int32)
+
+
+@register("_contrib_getnnz", aliases=("getnnz",), differentiable=False)
+def _getnnz(data, axis=None, **_):
+    """Count stored (nonzero) values (reference contrib/nnz.cc, CSR)."""
+    d = jnp.asarray(data)
+    if axis is None:
+        return jnp.sum(d != 0).astype(jnp.int32).reshape((1,))
+    return jnp.sum(d != 0, axis=axis).astype(jnp.int32)
+
+
+@register("_contrib_edge_id", aliases=("edge_id",), differentiable=False)
+def _edge_id(indptr, indices, edge_data, u, v, **_):
+    """Edge ids for (u,v) queries over a CSR graph whose data holds edge
+    ids (reference src/operator/contrib/dgl_graph.cc EdgeID); -1 where no
+    edge.  Inputs are the CSR triple as arrays (the CSRNDArray container
+    unpacks itself at the mx.nd.contrib.edge_id call site)."""
+    ip = jnp.asarray(indptr).astype(jnp.int32)
+    ci = jnp.asarray(indices).astype(jnp.int32)
+    ed = jnp.asarray(edge_data)
+    uu = jnp.asarray(u).astype(jnp.int32).ravel()
+    vv = jnp.asarray(v).astype(jnp.int32).ravel()
+
+    def one(ui, vi):
+        start, stop = ip[ui], ip[ui + 1]
+        pos = jnp.arange(ci.shape[0])
+        hit = (pos >= start) & (pos < stop) & (ci == vi)
+        return jnp.where(jnp.any(hit), ed[jnp.argmax(hit)], -1.0)
+
+    return jax.vmap(one)(uu, vv)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",),
+          differentiable=False)
+def _count_sketch(data, h, s, out_dim=None, **_):
+    """Count-sketch projection (reference contrib/count_sketch.cu): out[:,
+    h[j]] += s[j] * data[:, j]."""
+    x = jnp.asarray(data)
+    hh = jnp.asarray(h).astype(jnp.int32).ravel()
+    ss = jnp.asarray(s).ravel()
+    out = jnp.zeros(x.shape[:-1] + (int(out_dim),), x.dtype)
+    return out.at[..., hh].add(x * ss)
+
+
+@register("_contrib_hawkesll", aliases=("hawkesll",), num_outputs=2)
+def _hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time,
+              **_):
+    """Log-likelihood of a marked self-exciting Hawkes process with
+    exponential decay (reference src/operator/contrib/hawkes_ll.cc).
+
+    mu (K,) or (B,K) background rates; alpha (K,) branching; beta (K,)
+    decay; state (B,K) prior excitation; lags/marks (B,T); valid_length
+    (B,); max_time (B,).  Returns (ll (B,), new_state (B,K)) — identical
+    recursion to the reference kernel, expressed as a lax.scan over T.
+    """
+    mu_ = jnp.atleast_1d(jnp.asarray(mu, jnp.float32))
+    al = jnp.asarray(alpha, jnp.float32).ravel()
+    be = jnp.asarray(beta, jnp.float32).ravel()
+    st0 = jnp.asarray(state, jnp.float32)
+    lg = jnp.asarray(lags, jnp.float32)
+    mk = jnp.asarray(marks).astype(jnp.int32)
+    vl = jnp.asarray(valid_length).astype(jnp.int32).ravel()
+    mt = jnp.asarray(max_time, jnp.float32).ravel()
+    B, T = lg.shape
+    K = st0.shape[-1]
+    mu_b = jnp.broadcast_to(mu_, (B, K))
+
+    def step(carry, inp):
+        ll, state, t_acc = carry
+        lag, mark, pos = inp
+        decay = jnp.exp(-be[None, :] * lag[:, None])
+        state_d = state * decay
+        lam = mu_b + state_d                         # (B,K) intensities
+        lam_m = jnp.take_along_axis(lam, mark[:, None], 1)[:, 0]
+        valid = (pos < vl).astype(jnp.float32)
+        ll = ll + valid * jnp.log(jnp.maximum(lam_m, 1e-30))
+        # compensator increment over the lag interval
+        comp = jnp.sum((state - state_d) / be[None, :], axis=1) \
+            + jnp.sum(mu_b, axis=1) * lag
+        ll = ll - valid * comp
+        onehot = jax.nn.one_hot(mark, K, dtype=jnp.float32)
+        state = state_d + valid[:, None] * onehot * (al * be)[None, :]
+        return (ll, state, t_acc + valid * lag), None
+
+    (ll, state, t_sum), _ = lax.scan(
+        step, (jnp.zeros(B), st0, jnp.zeros(B)),
+        (lg.T, mk.T, jnp.arange(T)))
+    # tail compensator from the last event to max_time
+    rem = jnp.maximum(mt - t_sum, 0.0)
+    decay = jnp.exp(-be[None, :] * rem[:, None])
+    ll = ll - jnp.sum(mu_b, axis=1) * rem \
+        - jnp.sum(state * (1 - decay) / be[None, :], axis=1)
+    return ll, state * decay
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          aliases=("AdaptiveAvgPooling2D", "adaptive_avg_pool2d"))
+def _adaptive_avg_pool2d(data, output_size=(1, 1), **_):
+    """Adaptive average pooling (reference
+    src/operator/contrib/adaptive_avg_pooling.cc).
+
+    TPU-native formulation: the variable-window averages are exactly a pair
+    of fixed matmuls  W_h @ X @ W_w^T  with precomputed (static-shape)
+    overlap-fraction weight matrices — MXU work instead of per-window
+    gather loops.
+    """
+    d = jnp.asarray(data)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    elif len(output_size) == 1:
+        output_size = (output_size[0], output_size[0])
+    oh, ow = int(output_size[0]), int(output_size[1])
+    H, W = d.shape[2], d.shape[3]
+
+    def weights(out_n, in_n):
+        w = _np.zeros((out_n, in_n), _np.float32)
+        for o in range(out_n):
+            lo = (o * in_n) // out_n
+            hi = -(-((o + 1) * in_n) // out_n)  # ceil
+            w[o, lo:hi] = 1.0 / (hi - lo)
+        return jnp.asarray(w)
+
+    wh = weights(oh, H)
+    ww = weights(ow, W)
+    return jnp.einsum("oh,nchw,pw->ncop", wh, d, ww)
+
+
+# ------------------------------------------------------------ quantization
+# Completes the int8 graph-op set around the existing quantized FC/conv
+# (reference src/operator/quantization/*.cc).  Same convention as
+# ops/contrib.py: symmetric ranges, (out, min, max) outputs.
+
+def _range_pair(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(jnp.asarray(min_range, jnp.float32)),
+                       jnp.abs(jnp.asarray(max_range, jnp.float32)))
+    return -amax, amax
+
+
+@register("_contrib_quantize", aliases=("quantize",), differentiable=False,
+          num_outputs=3)
+def _quantize(data, min_range, max_range, out_type="int8", **_):
+    """f32 -> int8 with explicit range (reference quantization/quantize.cc;
+    the calib-range form of the existing quantize_v2)."""
+    lo, hi = _range_pair(min_range, max_range)
+    s = 127.0 / jnp.maximum(hi, 1e-12)
+    q = jnp.clip(jnp.round(jnp.asarray(data) * s), -127, 127)
+    return q.astype(jnp.int8), lo.reshape((1,)), hi.reshape((1,))
+
+
+@register("_contrib_requantize", aliases=("requantize",),
+          differentiable=False, num_outputs=3)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, **_):
+    """int32 accumulator -> int8 with (re)calibrated range (reference
+    quantization/requantize.cc)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    lo32, hi32 = _range_pair(min_range, max_range)
+    real = x * (hi32 / 2147483647.0)
+    if min_calib_range is not None and max_calib_range is not None:
+        lo, hi = _range_pair(min_calib_range, max_calib_range)
+    else:
+        hi = jnp.max(jnp.abs(real))
+        lo = -hi
+    s = 127.0 / jnp.maximum(hi, 1e-12)
+    q = jnp.clip(jnp.round(real * s), -127, 127)
+    return q.astype(jnp.int8), jnp.reshape(lo, (1,)), jnp.reshape(hi, (1,))
+
+
+@register("_contrib_quantized_act", aliases=("quantized_act",),
+          differentiable=False, num_outputs=3)
+def _quantized_act(data, min_data, max_data, act_type="relu", **_):
+    """int8 activation (reference quantized_activation.cc): relu keeps the
+    int8 grid; ranges pass through clipped at zero."""
+    q = jnp.asarray(data)
+    lo, hi = _range_pair(min_data, max_data)
+    if act_type == "relu":
+        return jnp.maximum(q, 0), jnp.zeros((1,)), hi.reshape((1,))
+    raise ValueError("quantized_act supports relu only (reference parity)")
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          differentiable=False, num_outputs=3)
+def _quantized_flatten(data, min_data, max_data, **_):
+    q = jnp.asarray(data)
+    lo, hi = _range_pair(min_data, max_data)
+    return (q.reshape(q.shape[0], -1), lo.reshape((1,)), hi.reshape((1,)))
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          differentiable=False, num_outputs=3)
+def _quantized_concat(*args, dim=1, num_args=None, **_):
+    """int8 concat (reference quantized_concat.cc): inputs are N data
+    tensors followed by N mins and N maxes; output rescales every part to
+    the widest range so the int8 grid is shared."""
+    n = num_args if num_args is not None else len(args) // 3
+    datas = [jnp.asarray(a).astype(jnp.float32) for a in args[:n]]
+    mins = [jnp.asarray(a) for a in args[n:2 * n]]
+    maxs = [jnp.asarray(a) for a in args[2 * n:3 * n]]
+    amaxs = [jnp.maximum(jnp.abs(lo).max(), jnp.abs(hi).max())
+             for lo, hi in zip(mins, maxs)]
+    amax = amaxs[0]
+    for a in amaxs[1:]:
+        amax = jnp.maximum(amax, a)
+    parts = [jnp.clip(jnp.round(d * (a / jnp.maximum(amax, 1e-12))),
+                      -127, 127)
+             for d, a in zip(datas, amaxs)]
+    out = jnp.concatenate(parts, axis=dim).astype(jnp.int8)
+    return out, (-amax).reshape((1,)), amax.reshape((1,))
+
+
+@register("_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), differentiable=False,
+          num_outputs=3)
+def _quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs,
+                            **_):
+    """int8 add with range merge (reference quantized_elemwise_add.cc)."""
+    _, ah = _range_pair(min_lhs, max_lhs)
+    _, bh = _range_pair(min_rhs, max_rhs)
+    fa = jnp.asarray(lhs).astype(jnp.float32) * (ah / 127.0)
+    fb = jnp.asarray(rhs).astype(jnp.float32) * (bh / 127.0)
+    out = fa + fb
+    amax = ah + bh
+    q = jnp.clip(jnp.round(out * (127.0 / jnp.maximum(amax, 1e-12))),
+                 -127, 127)
+    return q.astype(jnp.int8), (-amax).reshape((1,)), amax.reshape((1,))
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          differentiable=False, num_outputs=3)
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2), pool_type="max",
+                       stride=None, pad=None, global_pool=False, **_):
+    """int8 pooling (reference quantized_pooling.cc): max pool stays on the
+    int8 grid exactly; avg pool averages in f32 and re-rounds."""
+    from .nn import _pooling
+    lo, hi = _range_pair(min_data, max_data)
+    q = jnp.asarray(data)
+    out = _pooling(q.astype(jnp.float32), kernel=kernel, pool_type=pool_type,
+                   stride=stride, pad=pad, global_pool=global_pool)
+    out = jnp.round(out) if pool_type != "max" else out
+    return (jnp.clip(out, -127, 127).astype(jnp.int8),
+            lo.reshape((1,)), hi.reshape((1,)))
+
+
+@register("_contrib_quantized_batch_norm", aliases=("quantized_batch_norm",),
+          differentiable=False, num_outputs=3)
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data, max_data, eps=1e-3, **_):
+    """int8 inference BatchNorm (reference quantized_batch_norm.cc): folds
+    the affine transform in f32, recalibrates the output range."""
+    _, hi = _range_pair(min_data, max_data)
+    x = jnp.asarray(data).astype(jnp.float32) * (hi / 127.0)
+    g = jnp.asarray(gamma)
+    b = jnp.asarray(beta)
+    mm = jnp.asarray(moving_mean)
+    mv = jnp.asarray(moving_var)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mm.reshape(shape)) / jnp.sqrt(mv.reshape(shape) + eps) \
+        * g.reshape(shape) + b.reshape(shape)
+    amax = jnp.max(jnp.abs(y))
+    q = jnp.clip(jnp.round(y * (127.0 / jnp.maximum(amax, 1e-12))),
+                 -127, 127)
+    return q.astype(jnp.int8), (-amax).reshape((1,)), amax.reshape((1,))
+
+
+@register("_contrib_calibrate_entropy", aliases=("calibrate_entropy",),
+          differentiable=False, num_outputs=2)
+def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255, **_):
+    """KL-divergence threshold calibration (reference
+    quantization/calibrate.cc); delegates to the python implementation in
+    contrib/quantization.py (host-side, runs once at calibration time)."""
+    from ..contrib.quantization import _kl_threshold
+    h = _np.asarray(hist)
+    e = _np.asarray(hist_edges)
+    t = _kl_threshold(h, e, int(num_quantized_bins))
+    return (jnp.asarray([-t], jnp.float32), jnp.asarray([t], jnp.float32))
+
+
+# ------------------------------------------------------------ RPN proposals
+
+def _enum_anchors(scales, ratios, feat_h, feat_w, stride):
+    base = float(stride)
+    cx = cy = (base - 1) / 2.0
+    anchors = []
+    for r in ratios:
+        size = base * base
+        ws = _np.round(_np.sqrt(size / r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            anchors.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                            cx + (w - 1) / 2, cy + (h - 1) / 2])
+    A = _np.asarray(anchors, _np.float32)              # (A,4)
+    sx = _np.arange(feat_w) * stride
+    sy = _np.arange(feat_h) * stride
+    gx, gy = _np.meshgrid(sx, sy)
+    shifts = _np.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()], 1)
+    all_a = (A[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+    return jnp.asarray(all_a)                          # (H*W*A, 4)
+
+
+def _proposal_one(scores, deltas, anchors, im_info, rpn_pre_nms_top_n,
+                  rpn_post_nms_top_n, threshold, rpn_min_size):
+    """Single-image RPN proposal generation (static shapes)."""
+    # decode deltas (dx,dy,dw,dh) against anchors
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.clip(dw, -10, 10)) * aw
+    h = jnp.exp(jnp.clip(dh, -10, 10)) * ah
+    x1 = jnp.clip(cx - w / 2, 0, im_info[1] - 1)
+    y1 = jnp.clip(cy - h / 2, 0, im_info[0] - 1)
+    x2 = jnp.clip(cx + w / 2, 0, im_info[1] - 1)
+    y2 = jnp.clip(cy + h / 2, 0, im_info[0] - 1)
+    min_size = rpn_min_size * im_info[2]
+    keep = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1) >= min_size)
+    scores = jnp.where(keep, scores, -1e9)
+    k = min(rpn_pre_nms_top_n, scores.shape[0])
+    top_scores, top_idx = lax.top_k(scores, k)
+    boxes = jnp.stack([x1, y1, x2, y2], 1)[top_idx]
+    entries = jnp.concatenate([top_scores[:, None], boxes], 1)  # (k,5)
+    nms = _nms_one(entries, 0.0, threshold, rpn_post_nms_top_n,
+                   score_index=0, coord_start=1, id_index=-1,
+                   force_suppress=True)
+    out = nms[:rpn_post_nms_top_n]
+    s = out[:, 0]
+    rois = jnp.where(s[:, None] > 0, out[:, 1:5], 0.0)
+    return rois, jnp.maximum(s, 0.0)[:, None]
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal",
+                                        "MultiProposal"),
+          differentiable=False, num_outputs=2)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False, **_):
+    """Region-proposal generation (reference
+    src/operator/contrib/proposal.cc, multi_proposal.cc — MultiProposal is
+    the batched form; this implementation vmaps over the batch either way).
+
+    cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W), im_info (B, 3).
+    Returns (rois (B*post_n, 5) with batch index in col 0, scores).  Fixed
+    post_n output with zero padding replaces the reference's dynamic keep
+    list.
+    """
+    cp = jnp.asarray(cls_prob)
+    bp = jnp.asarray(bbox_pred)
+    info = jnp.asarray(im_info)
+    B, A2, H, W = cp.shape
+    A = A2 // 2
+    if A != len(scales) * len(ratios):
+        raise ValueError(
+            "Proposal: cls_prob has %d anchor channels but scales x ratios "
+            "gives %d anchors" % (A, len(scales) * len(ratios)))
+    anchors = _enum_anchors(scales, ratios, H, W, feature_stride)
+    # fg scores: second half of the 2A channel block, layout (A,H,W)
+    fg = cp[:, A:, :, :].transpose(0, 2, 3, 1).reshape(B, -1)   # (B,HWA)
+    deltas = bp.transpose(0, 2, 3, 1).reshape(B, -1, 4)
+
+    rois, scores = jax.vmap(
+        lambda s, d, ii: _proposal_one(
+            s, d, anchors, ii, int(rpn_pre_nms_top_n),
+            int(rpn_post_nms_top_n), float(threshold),
+            float(rpn_min_size)))(fg, deltas, info)
+    batch_ids = jnp.repeat(jnp.arange(B, dtype=rois.dtype),
+                           rois.shape[1])[:, None]
+    out = jnp.concatenate([batch_ids,
+                           rois.reshape(-1, 4)], 1)
+    return out, scores.reshape(-1, 1)
+
+
+# ----------------------------------------- position-sensitive ROI pooling
+
+def _tap_bilinear(feat, y, x):
+    """Bilinear tap of (C, H, W) features at one float point; zero outside
+    the image (the reference's boundary rule)."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def corner(yy, xx):
+        ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return feat[:, yc, xc] * ok.astype(feat.dtype)
+
+    top = corner(y0, x0) * (1 - wx) + corner(y0, x0 + 1) * wx
+    bot = corner(y0 + 1, x0) * (1 - wx) + corner(y0 + 1, x0 + 1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _integral(x):
+    """2-D integral image over the trailing axes (H, W)."""
+    c = jnp.cumsum(jnp.cumsum(x, axis=-2), axis=-1)
+    return jnp.pad(c, [(0, 0)] * (x.ndim - 2) + [(1, 0), (1, 0)])
+
+
+def _box_mean(ii, y0, y1, x0, x1):
+    """Mean over [y0,y1)x[x0,x1) from an integral image (..., H+1, W+1)."""
+    y0c = jnp.clip(y0, 0, ii.shape[-2] - 1)
+    y1c = jnp.clip(y1, 0, ii.shape[-2] - 1)
+    x0c = jnp.clip(x0, 0, ii.shape[-1] - 1)
+    x1c = jnp.clip(x1, 0, ii.shape[-1] - 1)
+    s = (ii[..., y1c, x1c] - ii[..., y0c, x1c]
+         - ii[..., y1c, x0c] + ii[..., y0c, x0c])
+    area = jnp.maximum((y1c - y0c) * (x1c - x0c), 1)
+    return s / area
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
+          differentiable=False)
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                   pooled_size=1, group_size=0, **_):
+    """Position-sensitive ROI pooling (reference
+    src/operator/contrib/psroi_pooling.cc): output channel (c, gy, gx)
+    averages input channel c*G*G + gy*G + gx over the (gy,gx) bin of the
+    ROI.  Integral-image bin sums keep every ROI O(1)."""
+    x = jnp.asarray(data)
+    r = jnp.asarray(rois)
+    G = int(group_size) if group_size else int(pooled_size)
+    P = int(pooled_size)
+    C = int(output_dim)
+    ii_all = _integral(x)                   # (B, C*G*G, H+1, W+1)
+
+    def one_roi(roi):
+        ii = ii_all[roi[0].astype(jnp.int32)]  # roi batch index
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        out = jnp.zeros((C, P, P), x.dtype)
+        for gy in range(P):
+            for gx in range(P):
+                yy0 = jnp.floor(y1 + rh * gy / P).astype(jnp.int32)
+                yy1 = jnp.ceil(y1 + rh * (gy + 1) / P).astype(jnp.int32)
+                xx0 = jnp.floor(x1 + rw * gx / P).astype(jnp.int32)
+                xx1 = jnp.ceil(x1 + rw * (gx + 1) / P).astype(jnp.int32)
+                cg = jnp.arange(C) * G * G + min(gy, G - 1) * G \
+                    + min(gx, G - 1)
+                vals = _box_mean(ii[cg], yy0, yy1, xx0, xx1)
+                out = out.at[:, gy, gx].set(vals)
+        return out
+
+    return jax.vmap(one_roi)(r)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), differentiable=False,
+          num_outputs=2)
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=1, pooled_size=1, group_size=0,
+                              part_size=0, sample_per_part=4, trans_std=0.1,
+                              no_trans=False, **_):
+    """Deformable PS-ROI pooling (reference
+    src/operator/contrib/deformable_psroi_pooling.cc): each bin's sampling
+    window shifts by a learned offset; bins are averaged from
+    sample_per_part^2 bilinear taps."""
+    x = jnp.asarray(data)
+    r = jnp.asarray(rois)
+    P = int(pooled_size)
+    G = int(group_size) if group_size else P
+    C = int(output_dim)
+    S = int(sample_per_part)
+    tr = None if (no_trans or trans is None) else jnp.asarray(trans)
+
+    def one_roi(roi, ridx):
+        feat = x[roi[0].astype(jnp.int32)]             # roi batch index
+        x1 = roi[1] * spatial_scale - 0.5
+        y1 = roi[2] * spatial_scale - 0.5
+        x2 = roi[3] * spatial_scale + 0.5
+        y2 = roi[4] * spatial_scale + 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / P, rh / P
+        out = jnp.zeros((C, P, P), x.dtype)
+        for gy in range(P):
+            for gx in range(P):
+                if tr is not None:
+                    dx = tr[ridx, 0, min(gy, tr.shape[2] - 1),
+                            min(gx, tr.shape[3] - 1)] * trans_std * rw
+                    dy = tr[ridx, 1, min(gy, tr.shape[2] - 1),
+                            min(gx, tr.shape[3] - 1)] * trans_std * rh
+                else:
+                    dx = dy = 0.0
+                cg = jnp.arange(C) * G * G + min(gy, G - 1) * G \
+                    + min(gx, G - 1)
+                acc = jnp.zeros((C,), x.dtype)
+                for sy in range(S):
+                    for sx in range(S):
+                        yy = y1 + gy * bin_h + (sy + 0.5) * bin_h / S + dy
+                        xx = x1 + gx * bin_w + (sx + 0.5) * bin_w / S + dx
+                        acc = acc + _tap_bilinear(
+                            feat[cg], jnp.asarray(yy), jnp.asarray(xx))
+                out = out.at[:, gy, gx].set(acc / (S * S))
+        return out
+
+    idx = jnp.arange(r.shape[0])
+    pooled = jax.vmap(one_roi)(r, idx)
+    return pooled, jnp.zeros_like(pooled)
+
+
+@register("_contrib_RROIAlign", aliases=("RROIAlign",),
+          differentiable=False)
+def _rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0, **_):
+    """Rotated ROI align (reference src/operator/contrib/rroi_align.cc):
+    rois are (batch, cx, cy, w, h, angle_deg); the pooled grid is rotated
+    into image space and sampled bilinearly."""
+    x = jnp.asarray(data)
+    r = jnp.asarray(rois)
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+
+    def one_roi(roi):
+        feat = x[roi[0].astype(jnp.int32)]             # roi batch index
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        w = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        h = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        ang = roi[5] * jnp.pi / 180.0
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        ys = (jnp.arange(ph) + 0.5) / ph - 0.5        # (-.5, .5) grid
+        xs = (jnp.arange(pw) + 0.5) / pw - 0.5
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        lx = gx * w
+        ly = gy * h
+        ix = cx + lx * cos - ly * sin
+        iy = cy + lx * sin + ly * cos
+        return jax.vmap(
+            lambda yy, xx: _tap_bilinear(feat, yy, xx),
+            in_axes=(0, 0), out_axes=1)(iy.ravel(), ix.ravel()).reshape(
+                (feat.shape[0], ph, pw))
+
+    return jax.vmap(one_roi)(r)
+
+
+# ----------------------------------------------------------------- aliases
+
+_CONTRIB_ALIASES = {
+    "_contrib_ctc_loss": "ctc_loss",
+    "_contrib_CTCLoss": "ctc_loss",
+    "CTCLoss": "ctc_loss",
+    "_contrib_box_non_maximum_suppression": "box_nms",
+    "_contrib_boolean_mask": "boolean_mask",
+    # SparseEmbedding IS Embedding with a row_sparse gradient; the
+    # sparse_grad attr selects the sparse vjp path (ops/tensor.py)
+    "_contrib_SparseEmbedding": "Embedding",
+    # cross-device BatchNorm statistics: inside a pjit-sharded step the BN
+    # moment reduction is already global (psum over the mesh), which IS
+    # SyncBatchNorm's semantics (reference contrib/sync_batch_norm.cc)
+    "_contrib_SyncBatchNorm": "BatchNorm",
+    "SyncBatchNorm": "BatchNorm",
+}
+
+for _alias, _target in _CONTRIB_ALIASES.items():
+    if _alias not in _REGISTRY:
+        _REGISTRY[_alias] = get(_target)

@@ -1,0 +1,498 @@
+"""Legacy-name parity ops: scalar arithmetic, v1 aliases, AMP casts, misc.
+
+Closes the operator long tail identified in the round-3 audit.  Three kinds
+of entries:
+
+* real ops the registry lacked (add_n, amp_cast, _histogram, _slice_assign,
+  _split_v2, _square_sum, ...) — implemented here with jnp lowerings;
+* scalar-operand forms (reference src/operator/tensor/
+  elemwise_binary_scalar_op_basic.cc) — in this framework scalars embed as
+  traced constants, so these exist for script/graph parity and lower to the
+  same XLA ops;
+* pure aliases the reference keeps for backward compatibility
+  (src/operator/tensor/elemwise_binary_broadcast_op_basic.cc add_alias
+  chains, the CamelCase v0.x names) — registered as registry aliases of the
+  canonical ops.
+
+The generated audit (docs/OP_AUDIT.md, tools/op_audit.py) enumerates every
+reference symbol against this registry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, get, _REGISTRY
+
+
+# --------------------------------------------------------------- scalar ops
+# (reference elemwise_binary_scalar_op_basic.cc / _extended.cc / _logic.cc)
+
+def _scalar_table():
+    return {
+        "_plus_scalar": lambda a, s: a + s,
+        "_minus_scalar": lambda a, s: a - s,
+        "_rminus_scalar": lambda a, s: s - a,
+        "_mul_scalar": lambda a, s: a * s,
+        "_div_scalar": lambda a, s: a / s,
+        "_rdiv_scalar": lambda a, s: s / a,
+        "_mod_scalar": lambda a, s: jnp.mod(a, s),
+        "_rmod_scalar": lambda a, s: jnp.mod(jnp.full_like(a, s), a),
+        "_power_scalar": lambda a, s: jnp.power(a, s),
+        "_rpower_scalar": lambda a, s: jnp.power(jnp.full_like(a, s), a),
+        "_maximum_scalar": lambda a, s: jnp.maximum(a, s),
+        "_minimum_scalar": lambda a, s: jnp.minimum(a, s),
+        "_hypot_scalar": lambda a, s: jnp.hypot(a, jnp.full_like(a, s)),
+        "_equal_scalar": lambda a, s: (a == s).astype(a.dtype),
+        "_not_equal_scalar": lambda a, s: (a != s).astype(a.dtype),
+        "_greater_scalar": lambda a, s: (a > s).astype(a.dtype),
+        "_greater_equal_scalar": lambda a, s: (a >= s).astype(a.dtype),
+        "_lesser_scalar": lambda a, s: (a < s).astype(a.dtype),
+        "_lesser_equal_scalar": lambda a, s: (a <= s).astype(a.dtype),
+        "_logical_and_scalar": lambda a, s:
+            ((a != 0) & bool(s)).astype(a.dtype),
+        "_logical_or_scalar": lambda a, s:
+            ((a != 0) | bool(s)).astype(a.dtype),
+        "_logical_xor_scalar": lambda a, s:
+            ((a != 0) ^ bool(s)).astype(a.dtype),
+        "_scatter_plus_scalar": lambda a, s: a + s,
+        "_scatter_minus_scalar": lambda a, s: a - s,
+    }
+
+
+_CAMEL_OF_SCALAR = {
+    "_plus_scalar": "_PlusScalar", "_minus_scalar": "_MinusScalar",
+    "_rminus_scalar": "_RMinusScalar", "_mul_scalar": "_MulScalar",
+    "_div_scalar": "_DivScalar", "_rdiv_scalar": "_RDivScalar",
+    "_mod_scalar": "_ModScalar", "_rmod_scalar": "_RModScalar",
+    "_power_scalar": "_PowerScalar", "_rpower_scalar": "_RPowerScalar",
+    "_maximum_scalar": "_MaximumScalar", "_minimum_scalar": "_MinimumScalar",
+    "_hypot_scalar": "_HypotScalar", "_equal_scalar": "_EqualScalar",
+    "_not_equal_scalar": "_NotEqualScalar",
+    "_greater_scalar": "_GreaterScalar",
+    "_greater_equal_scalar": "_GreaterEqualScalar",
+    "_lesser_scalar": "_LesserScalar",
+    "_lesser_equal_scalar": "_LesserEqualScalar",
+    "_logical_and_scalar": "_LogicalAndScalar",
+    "_logical_or_scalar": "_LogicalOrScalar",
+    "_logical_xor_scalar": "_LogicalXorScalar",
+}
+
+
+def _register_scalar_ops():
+    nondiff = {"_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+               "_greater_equal_scalar", "_lesser_scalar",
+               "_lesser_equal_scalar", "_logical_and_scalar",
+               "_logical_or_scalar", "_logical_xor_scalar"}
+    for name, fn in _scalar_table().items():
+        aliases = ()
+        if name in _CAMEL_OF_SCALAR:
+            aliases = (_CAMEL_OF_SCALAR[name],)
+
+        def impl(data, scalar=0.0, _fn=fn, **_):
+            return _fn(jnp.asarray(data), scalar)
+
+        register(name, differentiable=name not in nondiff,
+                 aliases=aliases)(impl)
+
+
+_register_scalar_ops()
+
+
+# ------------------------------------------------------------- legacy alias
+# reference keeps the v0.x CamelCase names working (add_alias chains)
+
+_LEGACY_ALIASES = {
+    # binary broadcast family
+    "_Plus": "broadcast_add", "_add": "broadcast_add",
+    "_plus": "broadcast_add", "_grad_add": "broadcast_add",
+    "broadcast_plus": "broadcast_add",
+    "_Minus": "broadcast_sub", "_sub": "broadcast_sub",
+    "_minus": "broadcast_sub", "broadcast_minus": "broadcast_sub",
+    "_Mul": "broadcast_mul", "_mul": "broadcast_mul",
+    "_Div": "broadcast_div", "_div": "broadcast_div",
+    "_Mod": "broadcast_mod", "_mod": "broadcast_mod",
+    "_Power": "broadcast_power",
+    "_Maximum": "broadcast_maximum", "_maximum": "broadcast_maximum",
+    "_Minimum": "broadcast_minimum", "_minimum": "broadcast_minimum",
+    "_Hypot": "broadcast_hypot", "_hypot": "broadcast_hypot",
+    "_Equal": "broadcast_equal", "equal": "broadcast_equal",
+    "_Not_Equal": "broadcast_not_equal", "not_equal": "broadcast_not_equal",
+    "_Greater": "broadcast_greater", "greater": "broadcast_greater",
+    "_Greater_Equal": "broadcast_greater_equal",
+    "greater_equal": "broadcast_greater_equal",
+    "_Lesser": "broadcast_lesser", "less": "broadcast_lesser",
+    "_Lesser_Equal": "broadcast_lesser_equal",
+    "less_equal": "broadcast_lesser_equal",
+    "_Logical_And": "broadcast_logical_and",
+    "_logical_and": "broadcast_logical_and",
+    "_Logical_Or": "broadcast_logical_or",
+    "_logical_or": "broadcast_logical_or",
+    "_Logical_Xor": "broadcast_logical_xor",
+    "_logical_xor": "broadcast_logical_xor",
+    "broadcast_axes": "broadcast_axis",
+    # misc canonical-name aliases
+    "choose_element_0index": "pick",
+    "_shuffle": "shuffle",
+    "_ravel_multi_index": "ravel_multi_index",
+    "_linalg_gemm2": "linalg_gemm2", "_linalg_potrf": "linalg_potrf",
+    "_linalg_syrk": "linalg_syrk", "_linalg_trsm": "linalg_trsm",
+    "SliceChannel": "split",
+    "Softmax": "softmax",
+    # v1 legacy layer ops: forward-compatible lowering to the modern ops
+    # (reference keeps *_v1 kernels for old graphs; numerics match for the
+    # supported layouts)
+    "BatchNorm_v1": "BatchNorm",
+    "Convolution_v1": "Convolution",
+    "Pooling_v1": "Pooling",
+}
+
+
+def _register_aliases():
+    for alias, target in _LEGACY_ALIASES.items():
+        if alias in _REGISTRY:
+            continue
+        try:
+            _REGISTRY[alias] = get(target)
+        except AttributeError:
+            raise RuntimeError(
+                "legacy alias %r -> missing target %r" % (alias, target))
+
+
+# ---------------------------------------------------------------- real ops
+
+@register("add_n", aliases=("ElementWiseSum",))
+def _add_n(*arrays, num_args=None, **_):
+    """Sum of N tensors in one op (reference
+    src/operator/tensor/elemwise_sum.cc; alias ElementWiseSum)."""
+    n = num_args if num_args is not None else len(arrays)
+    out = jnp.asarray(arrays[0])
+    for a in arrays[1:n]:
+        out = out + jnp.asarray(a)
+    return out
+
+
+@register("amp_cast")
+def _amp_cast(data, dtype="float32", **_):
+    """AMP-inserted cast (reference src/operator/tensor/amp_cast.cc)."""
+    from ..base import dtype_np
+    return jnp.asarray(data).astype(dtype_np(dtype))
+
+
+@register("amp_multicast", num_outputs=-1)
+def _amp_multicast(*arrays, num_outputs=None, cast_narrow=False, **_):
+    """Cast N tensors to a common width (reference amp_cast.cc
+    amp_multicast): widest dtype wins, or the narrowest with cast_narrow."""
+    n = num_outputs if num_outputs is not None else len(arrays)
+    arrs = [jnp.asarray(a) for a in arrays[:n]]
+
+    def width(d):
+        bits = jnp.finfo(d).bits if jnp.issubdtype(d, jnp.floating) else 0
+        return -bits if cast_narrow else bits
+
+    target = max((a.dtype for a in arrs), key=width)
+    return tuple(a.astype(target) for a in arrs)
+
+
+@register("cast_storage", differentiable=True)
+def _cast_storage(data, stype="default", **_):
+    """Storage-type cast (reference src/operator/tensor/cast_storage.cc).
+    Arrays are dense jax buffers at the registry level; the sparse
+    *containers* (ndarray/sparse.py) carry stype — so the value is the
+    identity and the NDArray layer re-wraps by stype."""
+    return jnp.asarray(data)
+
+
+@register("_histogram", aliases=("histogram",), differentiable=False,
+          num_outputs=2)
+def _histogram(data, bins=None, bin_cnt=10, range=None, **_):
+    """Histogram (reference src/operator/tensor/histogram.cc).  With a bins
+    tensor the edges are explicit; otherwise bin_cnt uniform bins over
+    range (default: data min/max)."""
+    d = jnp.asarray(data).ravel()
+    if bins is not None and getattr(bins, "ndim", 0) > 0:
+        edges = jnp.asarray(bins)
+        counts = jnp.histogram(d, bins=edges)[0]
+        return counts, edges
+    lo, hi = (range if range is not None
+              else (jnp.min(d), jnp.max(d)))
+    counts, edges = jnp.histogram(d, bins=int(bin_cnt), range=(lo, hi))
+    return counts, edges
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs, **_):
+    """Identity on lhs; rhs only contributes graph attrs (reference
+    elemwise_unary_op_basic.cc — the sparse-grad plumbing node)."""
+    return jnp.asarray(lhs)
+
+
+@register("_zeros_without_dtype", differentiable=False)
+def _zeros_without_dtype(shape=(), ctx=None, **_):
+    return jnp.zeros(shape, jnp.float32)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*arrays, dim=0, num_args=None, **_):
+    """Concatenate RNN parameter blocks (reference rnn.cc
+    _rnn_param_concat): plain concat whose gradient splits back."""
+    n = num_args if num_args is not None else len(arrays)
+    return jnp.concatenate([jnp.asarray(a) for a in arrays[:n]], axis=dim)
+
+
+@register("_split_v2", aliases=("split_v2",), num_outputs=-1)
+def _split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0, **_):
+    """split with either section counts or explicit indices (reference
+    src/operator/tensor/matrix_op.cc _split_v2)."""
+    d = jnp.asarray(data)
+    if sections and sections > 0:
+        parts = jnp.split(d, sections, axis=axis)
+    else:
+        parts = jnp.split(d, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("_square_sum", aliases=("square_sum",))
+def _square_sum(data, axis=None, keepdims=False, **_):
+    """sum(x**2) fused (reference src/operator/tensor/square_sum.cc — the
+    row_sparse-aware norm helper)."""
+    d = jnp.asarray(data)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(d * d, axis=ax, keepdims=keepdims)
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def _sparse_retain(data, indices, **_):
+    """Dense-image semantics of sparse_retain (reference
+    sparse_retain-inl.h): zero out every row NOT in indices.  The
+    container-level O(rows) path is ndarray.sparse.retain."""
+    d = jnp.asarray(data)
+    idx = jnp.asarray(indices).astype(jnp.int32).ravel()
+    mask = jnp.zeros((d.shape[0],), bool).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=None, **_):
+    """Scatter-write rhs into lhs at indices (reference matrix_op.cc
+    _scatter_set_nd — the backward of gather_nd with overwrite)."""
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return jnp.asarray(lhs).at[tuple(idx[i] for i in
+                                     range(idx.shape[0]))].set(
+        jnp.asarray(rhs))
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs, **_):
+    """Elementwise div writing through a sparse lhs pattern (reference
+    elemwise_binary_op_basic.cc _scatter_elemwise_div); dense image: plain
+    division."""
+    return jnp.asarray(lhs) / jnp.asarray(rhs)
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, begin=(), end=(), step=(), **_):
+    """Functional slice-assignment (reference matrix_op.cc _slice_assign;
+    x[a:b] = y lowers here) — .at[].set is the XLA-native form."""
+    d = jnp.asarray(lhs)
+    sl = _make_slices(d, begin, end, step)
+    return d.at[sl].set(jnp.asarray(rhs))
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(data, begin=(), end=(), step=(), scalar=0.0, **_):
+    d = jnp.asarray(data)
+    sl = _make_slices(d, begin, end, step)
+    return d.at[sl].set(scalar)
+
+
+def _make_slices(d, begin, end, step):
+    out = []
+    step = step or (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        out.append(slice(b, e, s if s not in (0,) else None))
+    return tuple(out)
+
+
+@register("fix")
+def _fix(data, **_):
+    """Round toward zero (reference elemwise_unary_op_basic.cc fix)."""
+    return jnp.fix(jnp.asarray(data))
+
+
+@register("_unravel_index", aliases=("unravel_index",),
+          differentiable=False)
+def _unravel_index(data, shape=None, **_):
+    idx = jnp.asarray(data).astype(jnp.int32)  # x64 stays off on TPU
+    coords = jnp.unravel_index(idx.ravel(), shape)
+    return jnp.stack(coords).reshape((len(shape),) + idx.shape)
+
+
+@register("_sample_unique_zipfian", differentiable=False, num_outputs=2)
+def _sample_unique_zipfian(range_max=None, shape=None, **_):
+    """Unique log-uniform (zipfian) candidate sampling (reference
+    contrib/unique_zipfian_op.cc, used by sampled-softmax training).
+    Eager host-side sampling: candidate sets are data-pipeline inputs, not
+    jit-internal values."""
+    n = int(_np.prod(shape)) if shape else 1
+    out = set()
+    log_rm = _np.log(range_max)
+    trials = 0
+    rng = _np.random
+    while len(out) < n:
+        draw = _np.exp(rng.uniform(0, log_rm, size=n * 2)) \
+            .astype(_np.int64)
+        draw = draw[draw < range_max]
+        trials += len(draw)
+        for v in draw:
+            out.add(int(v))
+            if len(out) == n:
+                break
+    samples = _np.asarray(sorted(out)[:n], _np.int32).reshape(shape)
+    # expected counts under the zipfian proposal for each sample
+    probs = _np.log1p(1.0 / (samples + 1)) / log_rm
+    counts = probs * trials
+    return jnp.asarray(samples), jnp.asarray(counts)
+
+
+@register("Crop", num_outputs=1)
+def _crop_legacy(*args, offset=(0, 0), h_w=(0, 0), center_crop=False, **_):
+    """v0.x Crop layer (reference src/operator/crop.cc): crop args[0] to
+    h_w (or to args[1]'s spatial shape) at offset / center."""
+    d = jnp.asarray(args[0])
+    if len(args) > 1:
+        ref_a = jnp.asarray(args[1])
+        th, tw = ref_a.shape[2], ref_a.shape[3]
+    else:
+        th, tw = h_w
+    H, W = d.shape[2], d.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    return d[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9, **_):
+    """Identity forward with a KL sparsity penalty attached in training
+    (reference src/operator/identity_attach_KL_sparse_reg.cc).  The penalty
+    is a regularization term users add to the loss in this framework
+    (functional design: losses compose instead of ops mutating gradients);
+    forward semantics (identity) are exact."""
+    return jnp.asarray(data)
+
+
+# ------------------------------------------------------------- image block
+# (reference src/operator/image/image_random.cc + resize.cc / crop.cc)
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def _image_to_tensor(data, **_):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference
+    image/image_random.cc ToTensor)."""
+    d = jnp.asarray(data).astype(jnp.float32) / 255.0
+    if d.ndim == 3:
+        return jnp.transpose(d, (2, 0, 1))
+    return jnp.transpose(d, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def _image_normalize(data, mean=(0.0,), std=(1.0,), **_):
+    d = jnp.asarray(data)
+    m = jnp.asarray(mean, d.dtype).reshape((-1, 1, 1))
+    s = jnp.asarray(std, d.dtype).reshape((-1, 1, 1))
+    return (d - m) / s
+
+
+@register("_image_resize", aliases=("image_resize",))
+def _image_resize(data, size=(), keep_ratio=False, interp=1, **_):
+    """Resize HWC or NHWC images (reference image/resize.cc) via
+    jax.image.resize — bilinear for interp=1, nearest otherwise."""
+    d = jnp.asarray(data)
+    if isinstance(size, int):
+        size = (size, size)
+    elif len(size) == 1:
+        size = (size[0], size[0])
+    w, h = size  # reference order: (w, h)
+    method = "bilinear" if interp == 1 else "nearest"
+    if d.ndim == 3:
+        return jax.image.resize(d, (h, w, d.shape[2]), method=method)
+    return jax.image.resize(d, (d.shape[0], h, w, d.shape[3]),
+                            method=method)
+
+
+@register("_image_crop", aliases=("image_crop",))
+def _image_crop(data, x=0, y=0, width=1, height=1, **_):
+    d = jnp.asarray(data)
+    if d.ndim == 3:
+        return d[y:y + height, x:x + width, :]
+    return d[:, y:y + height, x:x + width, :]
+
+
+_register_aliases()
+
+
+# ------------------------------------------------- STE / gradient-shaping
+# jax.custom_vjp carries the nonstandard gradients; apply_op's jax.vjp
+# taping composes with it transparently.
+
+@jax.custom_vjp
+def _round_ste_fn(x):
+    return jnp.round(x)
+
+
+_round_ste_fn.defvjp(lambda x: (jnp.round(x), None),
+                     lambda _, g: (g,))
+
+
+@register("_contrib_round_ste", aliases=("round_ste",))
+def _round_ste(data, **_):
+    """Round with straight-through gradient (reference
+    contrib/stes_op.cc RoundSTE — quantization-aware training)."""
+    return _round_ste_fn(jnp.asarray(data))
+
+
+@jax.custom_vjp
+def _sign_ste_fn(x):
+    return jnp.sign(x)
+
+
+_sign_ste_fn.defvjp(lambda x: (jnp.sign(x), None),
+                    lambda _, g: (g,))
+
+
+@register("_contrib_sign_ste", aliases=("sign_ste",))
+def _sign_ste(data, **_):
+    """Sign with straight-through gradient (reference contrib/stes_op.cc
+    SignSTE)."""
+    return _sign_ste_fn(jnp.asarray(data))
+
+
+def _make_gradmult(scalar):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (g * scalar,))
+    return f
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",))
+def _gradientmultiplier(data, scalar=1.0, **_):
+    """Identity forward, gradient scaled by `scalar` (reference
+    contrib/gradient_multiplier_op.cc — gradient-reversal layers use
+    scalar=-1)."""
+    return _make_gradmult(scalar)(jnp.asarray(data))
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def _div_sqrt_dim(data, **_):
+    """x / sqrt(last_dim) (reference contrib/transformer.cc
+    _contrib_div_sqrt_dim — attention-score scaling)."""
+    d = jnp.asarray(data)
+    return d / jnp.sqrt(jnp.asarray(d.shape[-1], d.dtype))

@@ -333,3 +333,184 @@ def _lamb_update_phase2(weight, g, r1, r2, lr=0.001, lower_bound=-1.0,
     ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v,
                       jnp.ones_like(r1v))
     return w - lr * ratio * jnp.asarray(g)
+
+
+@register("_multi_adamw_update", aliases=("multi_adamw_update",),
+          num_outputs=-1)
+def _multi_adamw_update(*args, lrs=(), wds=(), etas=(), beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                        num_weights=None, **_):
+    """Fused AdamW over N (weight, grad, mean, var) quadruples with ONE
+    trailing rescale_grad tensor (reference contrib/adamw.cc:143 — inputs
+    are 4*N+1); a NaN/Inf/0 scale skips the whole update, the dynamic-loss-
+    scale contract.  Returns N weights, then N means, then N vars."""
+    scale = jnp.asarray(args[-1]).reshape(())
+    ok = jnp.isfinite(scale) & (scale != 0)
+    safe = jnp.where(ok, scale, 1.0)
+    ws, ms, vs = [], [], []
+    for i, (w, g, m, v) in enumerate(_multi_pairs(args[:-1], 4)):
+        w = jnp.asarray(w)
+        g = jnp.asarray(g) * safe
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nm = beta1 * jnp.asarray(m) + (1 - beta1) * g
+        nv = beta2 * jnp.asarray(v) + (1 - beta2) * g * g
+        nw = w - etas[i] * (lrs[i] * nm / (jnp.sqrt(nv) + epsilon)
+                            + wds[i] * w)
+        ws.append(jnp.where(ok, nw, w))
+        ms.append(jnp.where(ok, nm, jnp.asarray(m)))
+        vs.append(jnp.where(ok, nv, jnp.asarray(v)))
+    return tuple(ws) + tuple(ms) + tuple(vs)
+
+
+@register("_multi_mp_adamw_update", aliases=("multi_mp_adamw_update",),
+          num_outputs=-1)
+def _multi_mp_adamw_update(*args, lrs=(), wds=(), etas=(), beta1=0.9,
+                           beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                           num_weights=None, **_):
+    """Multi-precision fused AdamW: N (weight, grad, mean, var, weight32)
+    quintuples + trailing rescale_grad (reference contrib/adamw.cc)."""
+    scale = jnp.asarray(args[-1]).reshape(())
+    ok = jnp.isfinite(scale) & (scale != 0)
+    safe = jnp.where(ok, scale, 1.0)
+    ws, ms, vs, w32s = [], [], [], []
+    for i, (w, g, m, v, w32) in enumerate(_multi_pairs(args[:-1], 5)):
+        w32 = jnp.asarray(w32)
+        g = (jnp.asarray(g) * safe).astype(jnp.float32)
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nm = beta1 * jnp.asarray(m) + (1 - beta1) * g
+        nv = beta2 * jnp.asarray(v) + (1 - beta2) * g * g
+        n32 = w32 - etas[i] * (lrs[i] * nm / (jnp.sqrt(nv) + epsilon)
+                               + wds[i] * w32)
+        n32 = jnp.where(ok, n32, w32)
+        ws.append(n32.astype(jnp.asarray(w).dtype))
+        ms.append(jnp.where(ok, nm, jnp.asarray(m)))
+        vs.append(jnp.where(ok, nv, jnp.asarray(v)))
+        w32s.append(n32)
+    return tuple(ws) + tuple(ms) + tuple(vs) + tuple(w32s)
+
+
+# -------------------------------------------------- preloaded multi-tensor
+# lrs/wds arrive as TENSOR inputs (the last two), so a whole LR schedule
+# sweep stays on device (reference contrib/preloaded_multi_sgd-inl.h:239).
+
+@register("preloaded_multi_sgd_update", num_outputs=-1)
+def _preloaded_multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                                num_weights=None, **_):
+    lrs = jnp.asarray(args[-2]).ravel()
+    wds = jnp.asarray(args[-1]).ravel()
+    outs = []
+    for i, (w, g) in enumerate(_multi_pairs(args[:-2], 2)):
+        outs.append(_sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", num_outputs=-1)
+def _preloaded_multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                    clip_gradient=-1.0, num_weights=None,
+                                    **_):
+    lrs = jnp.asarray(args[-2]).ravel()
+    wds = jnp.asarray(args[-1]).ravel()
+    ws, ms = [], []
+    for i, (w, g, m) in enumerate(_multi_pairs(args[:-2], 3)):
+        nw, nm = _sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                 wd=wds[i], rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+    return tuple(ws) + tuple(ms)
+
+
+@register("preloaded_multi_mp_sgd_update", num_outputs=-1)
+def _preloaded_multi_mp_sgd_update(*args, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None, **_):
+    lrs = jnp.asarray(args[-2]).ravel()
+    wds = jnp.asarray(args[-1]).ravel()
+    ws, w32s = [], []
+    for i, (w, g, w32) in enumerate(_multi_pairs(args[:-2], 3)):
+        nw, n32 = _mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        ws.append(nw)
+        w32s.append(n32)
+    return tuple(ws) + tuple(w32s)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", num_outputs=-1)
+def _preloaded_multi_mp_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                       clip_gradient=-1.0, num_weights=None,
+                                       **_):
+    lrs = jnp.asarray(args[-2]).ravel()
+    wds = jnp.asarray(args[-1]).ravel()
+    ws, ms, w32s = [], [], []
+    for i, (w, g, m, w32) in enumerate(_multi_pairs(args[:-2], 4)):
+        nw, nm, n32 = _mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+        w32s.append(n32)
+    return tuple(ws) + tuple(ms) + tuple(w32s)
+
+
+# --------------------------------------------------------- adagrad / sparse
+
+@register("_sparse_adagrad_update", num_outputs=2)
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                           rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Elementwise AdaGrad step (reference optimizer_op.cc
+    _sparse_adagrad_update; history += g*g per ELEMENT).  Registry-level
+    inputs are dense images; the O(rows-touched) sparse path lives in
+    optimizer.AdaGrad.step_rows, which the Trainer dispatches for
+    row_sparse grads."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    h = jnp.asarray(history) + g * g
+    return w - lr * g / (jnp.sqrt(h) + epsilon), h
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=("group_adagrad_update",), num_outputs=2)
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Group-AdaGrad (reference contrib/optimizer_op.cc
+    _contrib_group_adagrad_update): ONE accumulator per row — history +=
+    mean(g*g over the row)."""
+    w = jnp.asarray(weight)
+    g = _prep(grad, rescale_grad, clip_gradient)
+    if g.ndim > 1:
+        h = jnp.asarray(history) + jnp.mean(g * g, axis=tuple(
+            range(1, g.ndim)), keepdims=True)
+    else:
+        h = jnp.asarray(history) + g * g
+    return w - lr * g / (jnp.sqrt(h) + epsilon), h
+
+
+# ------------------------------------------------------- loss-scale helpers
+
+@register("all_finite", differentiable=False)
+def _all_finite(data, init_output=True, **_):
+    """1.0 iff every element is finite (reference contrib/all_finite.cc) —
+    the dynamic-loss-scaling overflow check."""
+    return jnp.all(jnp.isfinite(jnp.asarray(data))).astype(jnp.float32) \
+        .reshape((1,))
+
+
+@register("multi_all_finite", differentiable=False)
+def _multi_all_finite(*arrays, num_arrays=None, init_output=True, **_):
+    n = num_arrays if num_arrays is not None else len(arrays)
+    ok = jnp.array(True)
+    for a in arrays[:n]:
+        ok = ok & jnp.all(jnp.isfinite(jnp.asarray(a)))
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+@register("reset_arrays", differentiable=False, num_outputs=-1)
+def _reset_arrays(*arrays, num_arrays=None, **_):
+    """Zero N arrays in one fused call (reference contrib/reset_arrays.cc —
+    gradient clearing between accumulation windows)."""
+    n = num_arrays if num_arrays is not None else len(arrays)
+    return tuple(jnp.zeros_like(jnp.asarray(a)) for a in arrays[:n])

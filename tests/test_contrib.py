@@ -230,3 +230,85 @@ def test_quantized_conv_block_accuracy_vs_f32():
     scale = np.abs(f32_out).max()
     rel = np.abs(q_out - f32_out).max() / scale
     assert rel < 0.05, "int8 block diverged from f32: rel err %.4f" % rel
+
+
+def test_dgl_graph_ops():
+    """DGL sampling ops reproduce the reference docstring example
+    (src/operator/contrib/dgl_graph.cc:745,1116,1551): complete 5-vertex
+    graph, edge ids 1..20."""
+    data_np = np.arange(1, 21, dtype=np.int64)
+    indices_np = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                           0, 1, 2, 4, 0, 1, 2, 3])
+    indptr_np = np.array([0, 4, 8, 12, 16, 20])
+    a = mx.nd.sparse.csr_matrix((data_np, indices_np, indptr_np),
+                                shape=(5, 5))
+    seed = mx.nd.array(np.arange(5, dtype=np.float32))
+    v, sub, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    vv = np.asarray(v.asnumpy(), np.int64)
+    assert vv[-1] == 5 and sorted(vv[:5].tolist()) == [0, 1, 2, 3, 4]
+    dense = sub.asnumpy()
+    # 2 sampled edges per row, data = original edge ids
+    assert ((dense != 0).sum(axis=1) == 2).all()
+    orig = np.zeros((5, 5))
+    for r in range(5):
+        orig[r, indices_np[indptr_np[r]:indptr_np[r + 1]]] = \
+            data_np[indptr_np[r]:indptr_np[r + 1]]
+    nz = dense != 0
+    np.testing.assert_array_equal(dense[nz], orig[nz])
+
+    comp = mx.nd.contrib.dgl_graph_compact(
+        sub, v, graph_sizes=(int(vv[-1]),), return_mapping=False)
+    cd = comp.asnumpy()
+    assert cd.shape == (5, 5)
+    assert sorted(cd[cd != 0].astype(int).tolist()) == list(range(1, 11))
+
+    sg, mp = mx.nd.contrib.dgl_subgraph(
+        a, mx.nd.array(np.array([0, 1, 2], np.float32)),
+        return_mapping=True)
+    sgd, mpd = sg.asnumpy(), mp.asnumpy()
+    assert sgd.shape == (3, 3)
+    np.testing.assert_array_equal(sgd != 0, mpd != 0)
+    np.testing.assert_array_equal(
+        mpd[mpd != 0], orig[:3, :3][orig[:3, :3] != 0])
+
+    adj = mx.nd.contrib.dgl_adjacency(a)
+    assert adj.asnumpy().sum() == 20.0
+
+    outs = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, mx.nd.array(np.ones(5, np.float32)), seed, num_args=3,
+        num_hops=1, num_neighbor=2, max_num_vertices=5)
+    assert len(outs) == 4  # verts, csr, prob, layer per seed array
+
+
+def test_psroi_pooling_respects_roi_batch_index():
+    """An ROI with batch index 1 pools from image 1, not image 0
+    (reference psroi_pooling.cc per-roi batch_ind)."""
+    rng = np.random.RandomState(0)
+    img0 = np.zeros((8, 8, 8), np.float32)
+    img1 = np.ones((8, 8, 8), np.float32) * 5.0
+    data = np.stack([img0, img1])[None] if False else \
+        np.stack([img0, img1])          # (2, 8, 8, 8)
+    rois = np.array([[1, 0, 0, 31, 31]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.25,
+        output_dim=2, pooled_size=2)
+    np.testing.assert_allclose(out.asnumpy(), 5.0, rtol=1e-5)
+
+
+def test_sparse_vs_group_adagrad_ops_differ():
+    """_sparse_adagrad_update accumulates g*g per ELEMENT; the contrib
+    group op accumulates one value per row (reference optimizer_op.cc vs
+    contrib group_adagrad)."""
+    w = np.ones((2, 3), np.float32)
+    g = np.array([[1., 2., 3.], [1., 1., 1.]], np.float32)
+    h = np.zeros((2, 3), np.float32)
+    _, h_el = mx.nd._sparse_adagrad_update(
+        mx.nd.array(w), mx.nd.array(g), mx.nd.array(h), lr=0.1)
+    np.testing.assert_allclose(h_el.asnumpy(), g * g, rtol=1e-6)
+    hg = np.zeros((2, 1), np.float32)
+    _, h_grp = mx.nd.contrib.group_adagrad_update(
+        mx.nd.array(w), mx.nd.array(g), mx.nd.array(hg), lr=0.1)
+    np.testing.assert_allclose(
+        h_grp.asnumpy(), (g * g).mean(axis=1, keepdims=True), rtol=1e-6)

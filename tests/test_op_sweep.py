@@ -131,6 +131,9 @@ for _name, _ref in _BINARY.items():
 # atan2 is smooth only away from the negative-x branch cut: keep x positive
 spec("arctan2", inputs=lambda: [rnd(3, 4), pos(3, 4)],
      ref=lambda a, b, **_: np.arctan2(a, b))
+# hypot's gradient is ill-conditioned near the origin: bound operands away
+spec("broadcast_hypot", inputs=lambda: [pos(3, 4), pos(3, 4)],
+     ref=lambda a, b, **_: np.hypot(a, b))
 spec("broadcast_div", inputs=lambda: [rnd(3, 4), pos(3, 4)],
      ref=lambda a, b, **_: a / b)
 spec("broadcast_power", inputs=lambda: [pos(3, 4), rnd(3, 4)],
@@ -705,6 +708,244 @@ spec("_linspace", attrs={"start": 0.0, "stop": 1.0, "num": 5},
      ref=lambda **_: np.linspace(0, 1, 5, dtype=np.float32))
 spec("_eye", attrs={"N": 3},
      ref=lambda **_: np.eye(3, dtype=np.float32))
+
+# ------------------------------------------------- round-4 long-tail ops
+
+_SCALAR_REFS = {
+    "_plus_scalar": lambda a, s: a + s,
+    "_minus_scalar": lambda a, s: a - s,
+    "_rminus_scalar": lambda a, s: s - a,
+    "_mul_scalar": lambda a, s: a * s,
+    "_div_scalar": lambda a, s: a / s,
+    "_rdiv_scalar": lambda a, s: s / a,
+    "_power_scalar": lambda a, s: np.power(a, s),
+    "_hypot_scalar": lambda a, s: np.hypot(a, s),
+    "_equal_scalar": lambda a, s: (a == s).astype(a.dtype),
+    "_not_equal_scalar": lambda a, s: (a != s).astype(a.dtype),
+    "_greater_scalar": lambda a, s: (a > s).astype(a.dtype),
+    "_greater_equal_scalar": lambda a, s: (a >= s).astype(a.dtype),
+    "_lesser_scalar": lambda a, s: (a < s).astype(a.dtype),
+    "_lesser_equal_scalar": lambda a, s: (a <= s).astype(a.dtype),
+    "_logical_and_scalar": lambda a, s:
+        ((a != 0) & bool(s)).astype(a.dtype),
+    "_logical_or_scalar": lambda a, s:
+        ((a != 0) | bool(s)).astype(a.dtype),
+    "_logical_xor_scalar": lambda a, s:
+        ((a != 0) ^ bool(s)).astype(a.dtype),
+    "_scatter_plus_scalar": lambda a, s: a + s,
+    "_scatter_minus_scalar": lambda a, s: a - s,
+}
+for _n, _f in _SCALAR_REFS.items():
+    spec(_n, inputs=lambda: [pos(3, 4)], attrs={"scalar": 1.3},
+         ref=lambda a, scalar=1.3, _f=_f: _f(a, scalar))
+spec("_mod_scalar", inputs=lambda: [pos(3, 4)], attrs={"scalar": 1.3},
+     ref=lambda a, scalar=1.3: np.mod(a, scalar),
+     fwd_only="non-smooth at wrap points")
+spec("_rmod_scalar", inputs=lambda: [gt1(3, 4)], attrs={"scalar": 1.3},
+     ref=lambda a, scalar=1.3: np.mod(scalar, a),
+     fwd_only="non-smooth at wrap points")
+spec("_rpower_scalar", inputs=lambda: [unit(3, 4)], attrs={"scalar": 1.3},
+     ref=lambda a, scalar=1.3: np.power(scalar, a))
+spec("_maximum_scalar", inputs=lambda: [pos(3, 4)], attrs={"scalar": 1.3},
+     ref=lambda a, scalar=1.3: np.maximum(a, scalar),
+     fwd_only="non-smooth at the scalar crossing")
+spec("_minimum_scalar", inputs=lambda: [pos(3, 4)], attrs={"scalar": 1.3},
+     ref=lambda a, scalar=1.3: np.minimum(a, scalar),
+     fwd_only="non-smooth at the scalar crossing")
+
+spec("add_n", inputs=lambda: [rnd(3, 4), rnd(3, 4), rnd(3, 4)],
+     ref=lambda *a: a[0] + a[1] + a[2])
+spec("amp_cast", inputs=lambda: [rnd(3, 4)], attrs={"dtype": "float16"},
+     fwd_only="pure dtype cast")
+spec("amp_multicast", inputs=lambda: [rnd(3, 4), rnd(3, 4)],
+     attrs={"num_outputs": 2}, fwd_only="pure dtype cast")
+spec("cast_storage", inputs=lambda: [rnd(3, 4)],
+     attrs={"stype": "default"}, ref=lambda a, **_: a)
+spec("fix", inputs=lambda: [rnd(3, 4) * 3], ref=lambda a: np.fix(a))
+spec("_histogram", inputs=lambda: [rnd(40)], attrs={"bin_cnt": 5})
+spec("_identity_with_attr_like_rhs",
+     inputs=lambda: [rnd(3, 4), rnd(3, 4)],
+     ref=lambda a, b: a,
+     fwd_only="identity plumbing node; rhs carries no gradient")
+spec("_zeros_without_dtype", inputs=(), attrs={"shape": (2, 3)},
+     ref=lambda **_: np.zeros((2, 3), np.float32), grad=False)
+spec("_rnn_param_concat", inputs=lambda: [rnd(3, 2), rnd(4, 2)],
+     attrs={"dim": 0}, ref=lambda a, b, **_: np.concatenate([a, b], 0))
+spec("_split_v2", inputs=lambda: [rnd(4, 6)],
+     attrs={"indices": (2,), "axis": 1},
+     ref=lambda a, **_: tuple(np.split(a, [2], axis=1)))
+spec("_square_sum", inputs=lambda: [rnd(3, 4)], attrs={"axis": 1},
+     ref=lambda a, axis=1: np.sum(a * a, axis=axis))
+spec("_sparse_retain", inputs=lambda: [rnd(5, 3), np.array([1., 3.])],
+     fwd_only="integer row-index input")
+spec("_scatter_set_nd",
+     inputs=lambda: [rnd(4, 5), rnd(3),
+                     np.array([[0, 1, 2], [1, 2, 3]], np.float32)],
+     fwd_only="integer index input")
+spec("_scatter_elemwise_div", inputs=lambda: [rnd(3, 4), pos(3, 4)],
+     ref=lambda a, b: a / b)
+spec("_slice_assign",
+     inputs=lambda: [rnd(4, 5), rnd(2, 2)],
+     attrs={"begin": (0, 1), "end": (2, 3)})
+spec("_slice_assign_scalar", inputs=lambda: [rnd(4, 5)],
+     attrs={"begin": (0, 1), "end": (2, 3), "scalar": 7.0})
+spec("_unravel_index", inputs=lambda: [np.array([5., 7.])],
+     attrs={"shape": (3, 4)}, grad=False)
+spec("_sample_unique_zipfian", inputs=(),
+     attrs={"range_max": 1000, "shape": (6,)}, grad=False)
+spec("Crop", inputs=lambda: [rnd(1, 2, 6, 6)],
+     attrs={"h_w": (4, 4), "offset": (1, 1)},
+     ref=lambda a, **_: a[:, :, 1:5, 1:5])
+spec("IdentityAttachKLSparseReg", inputs=lambda: [pos(3, 4)],
+     ref=lambda a, **_: a)
+spec("_image_to_tensor", inputs=lambda: [pos(5, 6, 3) * 100],
+     ref=lambda a: np.transpose(a.astype(np.float32) / 255.0, (2, 0, 1)))
+spec("_image_normalize", inputs=lambda: [pos(3, 5, 6)],
+     attrs={"mean": (0.5,), "std": (2.0,)},
+     ref=lambda a, **_: (a - 0.5) / 2.0)
+spec("_image_resize", inputs=lambda: [pos(5, 6, 3)],
+     attrs={"size": (4, 3)})
+spec("_image_crop", inputs=lambda: [pos(6, 8, 3)],
+     attrs={"x": 1, "y": 2, "width": 4, "height": 3},
+     ref=lambda a, **_: a[2:5, 1:5, :])
+
+# fused optimizer updates: forward-value ops (state transitions), the
+# training-path gradients never flow through them
+spec("_multi_adamw_update",
+     inputs=lambda: [rnd(4), rnd(4), rnd(4) * 0, pos(4),
+                     np.ones(1, np.float32)],
+     attrs={"lrs": (0.1,), "wds": (0.01,), "etas": (1.0,)}, grad=False)
+spec("_multi_mp_adamw_update",
+     inputs=lambda: [rnd(4), rnd(4), rnd(4) * 0, pos(4), rnd(4),
+                     np.ones(1, np.float32)],
+     attrs={"lrs": (0.1,), "wds": (0.01,), "etas": (1.0,)}, grad=False)
+spec("preloaded_multi_sgd_update",
+     inputs=lambda: [rnd(4), rnd(4), np.array([0.1], np.float32),
+                     np.array([0.0], np.float32)], grad=False)
+spec("preloaded_multi_sgd_mom_update",
+     inputs=lambda: [rnd(4), rnd(4), rnd(4), np.array([0.1], np.float32),
+                     np.array([0.0], np.float32)],
+     attrs={"momentum": 0.9}, grad=False)
+spec("preloaded_multi_mp_sgd_update",
+     inputs=lambda: [rnd(4), rnd(4), rnd(4), np.array([0.1], np.float32),
+                     np.array([0.0], np.float32)], grad=False)
+spec("preloaded_multi_mp_sgd_mom_update",
+     inputs=lambda: [rnd(4), rnd(4), rnd(4), rnd(4),
+                     np.array([0.1], np.float32),
+                     np.array([0.0], np.float32)],
+     attrs={"momentum": 0.9}, grad=False)
+spec("_sparse_adagrad_update",
+     inputs=lambda: [rnd(4, 3), rnd(4, 3), pos(4, 3)],
+     attrs={"lr": 0.1}, grad=False)
+spec("_contrib_group_adagrad_update",
+     inputs=lambda: [rnd(4, 3), rnd(4, 3), pos(4, 1)],
+     attrs={"lr": 0.1}, grad=False)
+spec("all_finite", inputs=lambda: [rnd(3, 4)], grad=False,
+     ref=lambda a, **_: np.array([1.0], np.float32))
+spec("multi_all_finite", inputs=lambda: [rnd(3), rnd(3)], grad=False,
+     ref=lambda *a, **_: np.array([1.0], np.float32))
+spec("reset_arrays", inputs=lambda: [rnd(3), rnd(2, 2)], grad=False,
+     ref=lambda a, b, **_: (np.zeros_like(a), np.zeros_like(b)))
+
+# contrib completion
+spec("_contrib_quadratic", inputs=lambda: [rnd(3, 4)],
+     attrs={"a": 1.0, "b": 2.0, "c": 3.0},
+     ref=lambda x, a=1.0, b=2.0, c=3.0: a * x * x + b * x + c)
+spec("_contrib_allclose", inputs=lambda: [rnd(3, 4)] * 2, grad=False)
+spec("_contrib_arange_like", inputs=lambda: [rnd(3, 4)], grad=False,
+     ref=lambda a, **_: np.arange(12, dtype=np.float32).reshape(3, 4))
+spec("_contrib_index_copy",
+     inputs=lambda: [rnd(5, 3), np.array([1., 3.]), rnd(2, 3)],
+     fwd_only="integer index input")
+spec("_contrib_index_array", inputs=lambda: [rnd(2, 3)], grad=False)
+spec("_contrib_getnnz", inputs=lambda: [rnd(3, 4)], grad=False)
+spec("_contrib_edge_id",
+     inputs=lambda: [np.array([0., 2., 3.]), np.array([1., 2., 2.]),
+                     np.array([10., 11., 12.]), np.array([0., 1.]),
+                     np.array([2., 2.])], grad=False)
+spec("_contrib_count_sketch",
+     inputs=lambda: [rnd(2, 4), np.array([0., 1., 0., 1.]),
+                     np.array([1., -1., 1., -1.])],
+     attrs={"out_dim": 2}, grad=False)
+spec("_contrib_hawkesll",
+     inputs=lambda: [pos(2), pos(2) * 0.2, pos(2), pos(1, 2) * 0,
+                     pos(1, 3), np.zeros((1, 3), np.float32),
+                     np.array([3.]), np.array([2.0])],
+     fwd_only="integer marks input; params differentiate via jax.vjp")
+spec("_contrib_AdaptiveAvgPooling2D", inputs=lambda: [rnd(1, 2, 4, 4)],
+     attrs={"output_size": (2, 2)},
+     ref=lambda a, **_: a.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)))
+spec("_contrib_div_sqrt_dim", inputs=lambda: [rnd(3, 4)],
+     ref=lambda a: a / np.sqrt(4.0))
+spec("_contrib_gradientmultiplier", inputs=lambda: [rnd(3, 4)],
+     attrs={"scalar": -1.0}, ref=lambda a, **_: a,
+     fwd_only="gradient deliberately rescaled vs numeric")
+spec("_contrib_round_ste", inputs=lambda: [rnd(3, 4) * 3],
+     ref=lambda a: np.round(a),
+     fwd_only="straight-through gradient intentionally differs")
+spec("_contrib_sign_ste", inputs=lambda: [rnd(3, 4)],
+     ref=lambda a: np.sign(a),
+     fwd_only="straight-through gradient intentionally differs")
+spec("_contrib_quantize",
+     inputs=lambda: [unit(3, 4), np.array([-1.]), np.array([1.])],
+     grad=False)
+spec("_contrib_requantize",
+     inputs=lambda: [(RNG.randint(-1000, 1000, (3, 4))).astype(np.float32),
+                     np.array([-1.]), np.array([1.])], grad=False)
+spec("_contrib_quantized_act",
+     inputs=lambda: [(RNG.randint(-127, 127, (3, 4))).astype(np.float32),
+                     np.array([-1.]), np.array([1.])],
+     attrs={"act_type": "relu"}, grad=False)
+spec("_contrib_quantized_flatten",
+     inputs=lambda: [(RNG.randint(-127, 127, (2, 3, 4))).astype(np.float32),
+                     np.array([-1.]), np.array([1.])], grad=False)
+spec("_contrib_quantized_concat",
+     inputs=lambda: [(RNG.randint(-127, 127, (2, 3))).astype(np.float32),
+                     (RNG.randint(-127, 127, (2, 3))).astype(np.float32),
+                     np.array([-1.]), np.array([-2.]),
+                     np.array([1.]), np.array([2.])],
+     attrs={"dim": 1, "num_args": 2}, grad=False)
+spec("_contrib_quantized_elemwise_add",
+     inputs=lambda: [(RNG.randint(-127, 127, (3, 4))).astype(np.float32),
+                     (RNG.randint(-127, 127, (3, 4))).astype(np.float32),
+                     np.array([-1.]), np.array([1.]),
+                     np.array([-2.]), np.array([2.])], grad=False)
+spec("_contrib_quantized_pooling",
+     inputs=lambda: [(RNG.randint(-127, 127, (1, 2, 4, 4))
+                      ).astype(np.float32),
+                     np.array([-1.]), np.array([1.])],
+     attrs={"kernel": (2, 2), "stride": (2, 2)}, grad=False)
+spec("_contrib_quantized_batch_norm",
+     inputs=lambda: [(RNG.randint(-127, 127, (2, 3, 4, 4))
+                      ).astype(np.float32),
+                     pos(3), rnd(3), rnd(3), pos(3),
+                     np.array([-1.]), np.array([1.])], grad=False)
+spec("_contrib_calibrate_entropy",
+     inputs=lambda: [np.histogram(RNG.randn(2000), bins=64)[0]
+                     .astype(np.float32),
+                     np.histogram(RNG.randn(2000), bins=64)[1]
+                     .astype(np.float32)],
+     attrs={"num_quantized_bins": 31}, grad=False)
+spec("_contrib_PSROIPooling",
+     inputs=lambda: [rnd(1, 8, 6, 6),
+                     np.array([[0, 0, 0, 20, 20]], np.float32)],
+     attrs={"spatial_scale": 0.25, "output_dim": 2, "pooled_size": 2},
+     grad=False)
+spec("_contrib_DeformablePSROIPooling",
+     inputs=lambda: [rnd(1, 8, 6, 6),
+                     np.array([[0, 0, 0, 20, 20]], np.float32)],
+     attrs={"spatial_scale": 0.25, "output_dim": 2, "pooled_size": 2,
+            "no_trans": True}, grad=False)
+spec("_contrib_RROIAlign",
+     inputs=lambda: [rnd(1, 3, 8, 8),
+                     np.array([[0, 12, 12, 8, 6, 30]], np.float32)],
+     attrs={"pooled_size": (2, 2), "spatial_scale": 0.25}, grad=False)
+spec("_contrib_Proposal",
+     inputs=lambda: [probs(1, 2, 4, 4), rnd(1, 4, 4, 4) * 0.1,
+                     np.array([[64, 64, 1.0]], np.float32)],
+     attrs={"rpn_pre_nms_top_n": 12, "rpn_post_nms_top_n": 4,
+            "scales": (8,), "ratios": (1.0,), "feature_stride": 16},
+     grad=False)
 
 EXEMPT = {
     # name -> reason a forward sweep invocation is impossible/meaningless

@@ -1,0 +1,156 @@
+"""Operator-coverage audit generator.
+
+Classifies EVERY operator name the reference registers (docs/
+ref_op_names.txt — extracted from src/operator NNVM_REGISTER_OP /
+MXNET_OPERATOR_REGISTER_* / MXNET_REGISTER_OP_PROPERTY macros plus
+add_alias chains, backward nodes excluded) against this framework's op
+registry, and writes docs/OP_AUDIT.md.
+
+Statuses:
+  implemented   — name resolves in mxnet_tpu.ops.registry
+  subsumed      — capability exists under a different mechanism (cited)
+  excluded      — deliberately out of scope (reason given)
+
+The generator RAISES if any reference name is unclassified, so the audit
+can never silently rot; tests/test_op_audit.py runs it in CI.
+
+Regenerate with:  python tools/op_audit.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+NAMES_FILE = os.path.join(REPO, "docs", "ref_op_names.txt")
+OUT_FILE = os.path.join(REPO, "docs", "OP_AUDIT.md")
+
+# Curated classifications for names that are not (and should not be)
+# registry entries.  Every entry carries its justification.
+CURATED = {
+    # --- callback / bridge ops superseded by the CustomOp design
+    "Custom": ("implemented",
+               "mxnet_tpu/operator.py CustomOp/CustomOpProp over "
+               "pure_callback + custom_vjp"),
+    "_NDArray": ("excluded", "v0.x NDArray-callback bridge; CustomOp "
+                 "(operator.py) is the supported custom-op path"),
+    "_Native": ("excluded", "v0.x native-callback bridge; CustomOp "
+                "(operator.py) is the supported custom-op path"),
+    # --- vendor/backend-specific kernels
+    "CuDNNBatchNorm": ("excluded", "cuDNN-specific; BatchNorm lowers to "
+                       "XLA on TPU"),
+    "_TensorRT": ("excluded", "TensorRT subgraph op; deploy.py StableHLO "
+                  "export is the inference-engine path"),
+    "_sg_mkldnn_conv": ("excluded", "MKLDNN fused subgraph; XLA fusion "
+                        "performs the same role on TPU"),
+    "_sg_mkldnn_fully_connected": ("excluded", "MKLDNN fused subgraph; "
+                                   "XLA fusion performs the same role"),
+    "_contrib_tvm_vadd": ("excluded", "TVM-bridge demo op; mx.rtc Pallas "
+                          "kernels are the custom-kernel path"),
+    # --- engine-internal nodes subsumed by jax autograd
+    "_broadcast_backward": ("subsumed", "jax.vjp of broadcasting ops "
+                            "(fused fwd+bwd programs)"),
+    "_split_v2_backward": ("subsumed", "jax.vjp of _split_v2"),
+    "_contrib_backward_gradientmultiplier": ("subsumed",
+                                             "custom_vjp of "
+                                             "_contrib_gradientmultiplier"),
+    "_contrib_backward_hawkesll": ("subsumed", "jax.vjp of "
+                                   "_contrib_hawkesll"),
+    "_contrib_backward_index_copy": ("subsumed", "jax.vjp of "
+                                     "_contrib_index_copy"),
+    "_contrib_backward_quadratic": ("subsumed", "jax.vjp of "
+                                    "_contrib_quadratic"),
+    "_CrossDeviceCopy": ("subsumed", "jax.device_put / NDArray.as_in_"
+                         "context"),
+    # --- control flow: functional form (callables can't live in a
+    #     value-level registry; reference exposes these via
+    #     mx.nd.contrib.foreach etc., which is exactly what exists here)
+    "_foreach": ("implemented", "ops/control_flow.py foreach (lax.scan)"),
+    "_while_loop": ("implemented", "ops/control_flow.py while_loop "
+                    "(lax.while_loop)"),
+    "_cond": ("implemented", "ops/control_flow.py cond (lax.cond)"),
+    # --- DGL graph ops: host-side container-level implementations (the
+    #     reference runs them CPU-only FComputeEx as well)
+    "_contrib_dgl_adjacency": ("implemented", "ndarray/dgl.py "
+                               "dgl_adjacency"),
+    "_contrib_dgl_csr_neighbor_uniform_sample":
+        ("implemented", "ndarray/dgl.py dgl_csr_neighbor_uniform_sample"),
+    "_contrib_dgl_csr_neighbor_non_uniform_sample":
+        ("implemented",
+         "ndarray/dgl.py dgl_csr_neighbor_non_uniform_sample"),
+    "_contrib_dgl_graph_compact": ("implemented", "ndarray/dgl.py "
+                                   "dgl_graph_compact"),
+    "_contrib_dgl_subgraph": ("implemented", "ndarray/dgl.py "
+                              "dgl_subgraph"),
+    # --- macro-extraction artifacts (template parameter names captured by
+    #     the registration-macro scan; not operators)
+    "distr": ("excluded", "not an op — sampler macro template parameter"),
+    "name": ("excluded", "not an op — macro template parameter"),
+}
+
+NP_NOTE = ("subsumed", "mx.np delegation: jnp functions taped through the "
+           "__getattr__ dispatch (numpy/__init__.py); the _np*/_npi*/_npx* "
+           "names are the reference's internal dispatch targets, which "
+           "this design does not need")
+
+
+def classify():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.ops.registry import _REGISTRY
+
+    names = [l.strip() for l in open(NAMES_FILE) if l.strip()]
+    rows = []
+    unclassified = []
+    for n in names:
+        if n in _REGISTRY:
+            rows.append((n, "implemented", "ops registry"))
+        elif n in CURATED:
+            status, why = CURATED[n]
+            rows.append((n, status, why))
+        elif n.startswith(("_np", "_npi", "_npx")):
+            rows.append((n, NP_NOTE[0], NP_NOTE[1]))
+        else:
+            unclassified.append(n)
+    if unclassified:
+        raise SystemExit("UNCLASSIFIED reference ops (%d):\n%s" % (
+            len(unclassified), "\n".join(unclassified)))
+    return rows
+
+
+def main():
+    rows = classify()
+    counts = {}
+    for _, s, _w in rows:
+        counts[s] = counts.get(s, 0) + 1
+    with open(OUT_FILE, "w") as f:
+        f.write(
+            "# Operator audit\n\n"
+            "Generated by `python tools/op_audit.py` — every operator "
+            "name the reference registers (docs/ref_op_names.txt, %d "
+            "names; `_backward_*` engine nodes excluded as subsumed by "
+            "jax.vjp), classified against this framework's registry.  "
+            "The generator fails on unclassified names, so this table is "
+            "complete by construction.\n\n" % len(rows))
+        f.write("| status | count |\n|---|---|\n")
+        for s in ("implemented", "subsumed", "excluded"):
+            f.write("| %s | %d |\n" % (s, counts.get(s, 0)))
+        f.write("\n")
+        for status in ("subsumed", "excluded"):
+            f.write("\n## %s\n\n| op | how / why |\n|---|---|\n" % status)
+            for n, s, why in rows:
+                if s == status and why != "ops registry":
+                    f.write("| `%s` | %s |\n" % (n, why))
+        f.write("\n## implemented\n\nResolvable in `mxnet_tpu.ops."
+                "registry` (direct, alias, or cited module):\n\n")
+        impl = [n for n, s, _ in rows if s == "implemented"]
+        for i in range(0, len(impl), 6):
+            f.write("`" + "` `".join(impl[i:i + 6]) + "`\n")
+    print("wrote %s: %s" % (OUT_FILE, counts))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
