@@ -1,54 +1,30 @@
-"""``mx.contrib.onnx`` — ONNX interchange (gated).
+"""``mx.contrib.onnx`` — ONNX interchange with NO external onnx package.
 
-Reference: python/mxnet/contrib/onnx/ (import_model/export_model over the
-onnx package).  The ``onnx`` package is not part of this environment, and
-the TPU-native interchange format is StableHLO — ``mx.deploy.export_model``
-/ ``load_model`` cover the deployment role (serialized compiler IR + params,
-reloadable from any process or a C++ PjRt runtime).
+Reference: python/mxnet/contrib/onnx/ (mx2onnx/export_model.py,
+onnx2mx/import_model.py).  Like the reference — which implements its own
+mx->onnx conversion rather than shelling out — this package carries its
+own serialization: a vendored minimal ONNX schema (onnx_minimal.proto;
+field numbers follow the public spec, so exported files load in any ONNX
+runtime and standard ONNX files import here).
 
-When ``onnx`` IS installed, export works by round-tripping through the
-StableHLO path is still preferred; import_model raises with guidance.
+google.protobuf backs the (generated) serialization, so the submodules
+load lazily: importing mxnet_tpu works on protobuf-less installs, and
+only calling an ONNX function requires the runtime.
+
+The TPU-native *deployment* format remains StableHLO
+(mx.deploy.export_model / load_model — serialized XLA program + params);
+ONNX is the cross-framework interchange surface.
 """
 from __future__ import annotations
 
 __all__ = ["import_model", "export_model", "get_model_metadata"]
 
-_GUIDANCE = (
-    "the 'onnx' package is not available in this environment; the "
-    "TPU-native interchange is StableHLO — use mx.deploy.export_model / "
-    "mx.deploy.load_model (serialized XLA program + params). "
-    "If you need ONNX specifically, install onnx and re-run."
-)
 
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return True
-    except ImportError:
-        raise ImportError(_GUIDANCE) from None
-
-
-_INSTALLED_GUIDANCE = (
-    "ONNX interchange is not implemented in this framework; the TPU-native "
-    "format is StableHLO — use mx.deploy.export_model / mx.deploy.load_model "
-    "(serialized XLA program + params, reloadable from any process)."
-)
-
-
-def import_model(model_file):
-    """Reference: contrib/onnx/onnx2mx/import_model.py."""
-    _require_onnx()
-    raise NotImplementedError(_INSTALLED_GUIDANCE)
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Reference: contrib/onnx/mx2onnx/export_model.py."""
-    _require_onnx()
-    raise NotImplementedError(_INSTALLED_GUIDANCE)
-
-
-def get_model_metadata(model_file):
-    _require_onnx()
-    raise NotImplementedError(_INSTALLED_GUIDANCE)
+def __getattr__(name):
+    if name == "export_model":
+        from .mx2onnx import export_model
+        return export_model
+    if name in ("import_model", "get_model_metadata"):
+        from . import onnx2mx
+        return getattr(onnx2mx, name)
+    raise AttributeError(name)

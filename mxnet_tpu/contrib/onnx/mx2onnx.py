@@ -1,0 +1,328 @@
+"""Symbol graph -> ONNX ModelProto, with no external onnx dependency.
+
+Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py + the
+_op_translations table.  This implementation serializes through the
+vendored minimal ONNX schema (onnx_minimal.proto — field numbers follow
+the public spec, so the output loads in any ONNX runtime) instead of
+requiring the onnx package.
+
+Per-op converters live in _CONVERTERS; each takes (node, ctx) and appends
+NodeProtos.  ctx carries name resolution (mx node -> ONNX tensor name),
+the initializer list, and a helper to emit constant tensors.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import onnx_minimal_pb2 as O
+
+OPSET = 13
+
+# ONNX TensorProto.DataType
+_DT_FLOAT, _DT_INT32, _DT_INT64, _DT_FLOAT16 = 1, 6, 7, 10
+_NP_TO_ONNX = {"float32": _DT_FLOAT, "int32": _DT_INT32,
+               "int64": _DT_INT64, "float16": _DT_FLOAT16}
+
+# AttributeProto.AttributeType
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
+
+
+def _attr(name, value):
+    a = O.AttributeProto(name=name)
+    if isinstance(value, bool):
+        a.type = _AT_INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = _AT_INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = _AT_FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = _AT_STRING
+        a.s = value.encode()
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.type = _AT_FLOATS
+            a.floats.extend(value)
+        else:
+            a.type = _AT_INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise TypeError("attr %r: %r" % (name, value))
+    return a
+
+
+def _tensor(name, arr):
+    arr = _np.asarray(arr)
+    t = O.TensorProto(name=name)
+    t.dims.extend(arr.shape)
+    dt = _NP_TO_ONNX.get(str(arr.dtype))
+    if dt is None:
+        arr = arr.astype(_np.float32)
+        dt = _DT_FLOAT
+    t.data_type = dt
+    t.raw_data = arr.tobytes()
+    return t
+
+
+class _Ctx:
+    def __init__(self, graph):
+        self.graph = graph
+        self.names = {}          # (node_id, index) -> onnx tensor name
+        self.counter = 0
+
+    def out_name(self, node):
+        key = (id(node), getattr(node, "index", 0))
+        if key not in self.names:
+            if node.kind == "var":
+                self.names[key] = node.name
+            else:
+                self.names[key] = "%s_%d" % (node.op, self.counter)
+                self.counter += 1
+        return self.names[key]
+
+    def add_node(self, op_type, inputs, outputs, attrs=None, name=None):
+        n = self.graph.node.add()
+        n.op_type = op_type
+        n.name = name or (op_type + "_" + outputs[0])
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in (attrs or {}).items():
+            n.attribute.append(_attr(k, v))
+        return n
+
+    def const(self, arr, dtype=None):
+        cname = "const_%d" % self.counter
+        self.counter += 1
+        a = _np.asarray(arr, dtype)
+        self.graph.initializer.append(_tensor(cname, a))
+        return cname
+
+
+def _pads2(p):
+    p = list(p)
+    return p + p  # ONNX wants begin+end per spatial axis
+
+
+def _conv(node, ins, out, ctx):
+    at = node.attrs
+    attrs = {"kernel_shape": list(at["kernel"]),
+             "strides": list(at.get("stride") or [1] * len(at["kernel"])),
+             "dilations": list(at.get("dilate") or [1] * len(at["kernel"])),
+             "pads": _pads2(at.get("pad") or [0] * len(at["kernel"])),
+             "group": int(at.get("num_group", 1))}
+    ctx.add_node("Conv", ins[:2] if at.get("no_bias") else ins[:3],
+                 [out], attrs)
+
+
+def _fc(node, ins, out, ctx):
+    at = node.attrs
+    data = ins[0]
+    if at.get("flatten", True):
+        flat = out + "_flat"
+        ctx.add_node("Flatten", [data], [flat], {"axis": 1})
+        data = flat
+    inputs = [data, ins[1]]
+    if not at.get("no_bias"):
+        inputs.append(ins[2])
+    ctx.add_node("Gemm", inputs, [out],
+                 {"alpha": 1.0, "beta": 1.0, "transB": 1})
+
+
+def _bn(node, ins, out, ctx):
+    at = node.attrs
+    ctx.add_node("BatchNormalization", ins[:5], [out],
+                 {"epsilon": float(at.get("eps", 1e-5)),
+                  "momentum": float(at.get("momentum", 0.9))})
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softsign": "Softsign", "softrelu": "Softplus"}
+
+
+def _activation(node, ins, out, ctx):
+    ctx.add_node(_ACT[node.attrs.get("act_type", "relu")], ins[:1], [out])
+
+
+def _pooling(node, ins, out, ctx):
+    at = node.attrs
+    ptype = at.get("pool_type", "max")
+    if at.get("global_pool"):
+        ctx.add_node("GlobalAveragePool" if ptype == "avg"
+                     else "GlobalMaxPool", ins[:1], [out])
+        return
+    attrs = {"kernel_shape": list(at["kernel"]),
+             "strides": list(at.get("stride") or [1] * len(at["kernel"])),
+             "pads": _pads2(at.get("pad") or [0] * len(at["kernel"]))}
+    if ptype == "avg":
+        attrs["count_include_pad"] = 1 if at.get(
+            "count_include_pad", True) else 0
+        ctx.add_node("AveragePool", ins[:1], [out], attrs)
+    else:
+        ctx.add_node("MaxPool", ins[:1], [out], attrs)
+
+
+def _binary(onnx_op):
+    def conv(node, ins, out, ctx):
+        ctx.add_node(onnx_op, ins[:2], [out])
+    return conv
+
+
+def _softmax(node, ins, out, ctx):
+    ctx.add_node("Softmax", ins[:1], [out],
+                 {"axis": int(node.attrs.get("axis", -1))})
+
+
+def _flatten(node, ins, out, ctx):
+    ctx.add_node("Flatten", ins[:1], [out], {"axis": 1})
+
+
+def _dropout(node, ins, out, ctx):
+    # inference graph: dropout is identity
+    ctx.add_node("Identity", ins[:1], [out])
+
+
+def _concat(node, ins, out, ctx):
+    ctx.add_node("Concat", ins, [out],
+                 {"axis": int(node.attrs.get("dim", 1))})
+
+
+def _reshape(node, ins, out, ctx):
+    dims = list(node.attrs.get("shape", (-1,)))
+    if any(d < -1 for d in dims):
+        # mx's -2/-3/-4 split/merge codes have no ONNX encoding; emitting
+        # them verbatim would produce files other runtimes reject
+        raise NotImplementedError(
+            "ONNX Reshape supports only 0/-1 shape codes, got %r" % (dims,))
+    shape = ctx.const(dims, _np.int64)
+    ctx.add_node("Reshape", [ins[0], shape], [out])
+
+
+def _transpose(node, ins, out, ctx):
+    ctx.add_node("Transpose", ins[:1], [out],
+                 {"perm": list(node.attrs.get("axes", ()))})
+
+
+def _embedding(node, ins, out, ctx):
+    # mx Embedding(data, weight) == Gather(weight, indices)
+    idx64 = out + "_idx"
+    ctx.add_node("Cast", [ins[0]], [idx64], {"to": _DT_INT64})
+    ctx.add_node("Gather", [ins[1], idx64], [out], {"axis": 0})
+
+
+_CONVERTERS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "BatchNorm": _bn,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "softmax": _softmax,
+    "SoftmaxOutput": _softmax,
+    "SoftmaxActivation": _softmax,
+    "Dropout": _dropout,
+    "Concat": _concat,
+    "concat": _concat,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "Embedding": _embedding,
+    "broadcast_add": _binary("Add"),
+    "elemwise_add": _binary("Add"),
+    "broadcast_sub": _binary("Sub"),
+    "elemwise_sub": _binary("Sub"),
+    "broadcast_mul": _binary("Mul"),
+    "elemwise_mul": _binary("Mul"),
+    "broadcast_div": _binary("Div"),
+    "elemwise_div": _binary("Div"),
+    "relu": _activation,
+    "sigmoid": lambda n, i, o, c: c.add_node("Sigmoid", i[:1], [o]),
+    "tanh": lambda n, i, o, c: c.add_node("Tanh", i[:1], [o]),
+    "LeakyReLU": lambda n, i, o, c: c.add_node(
+        "LeakyRelu", i[:1], [o],
+        {"alpha": float(n.attrs.get("slope", 0.25))}),
+}
+
+
+def export_model(sym, params, input_shapes, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Serialize (Symbol, params) to an ONNX file (reference:
+    mx2onnx/export_model.py:export_model same signature).  Returns the
+    path.  `params` maps both arg and aux names (arg:/aux: prefixes are
+    stripped like the reference does)."""
+    from ...symbol.symbol import _topo
+    from ...ndarray.ndarray import NDArray
+
+    clean = {}
+    for k, v in (params or {}).items():
+        k = k.split(":", 1)[-1]
+        clean[k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+
+    model = O.ModelProto(ir_version=8, producer_name="mxnet_tpu",
+                         producer_version="1.0")
+    ops = model.opset_import.add()
+    ops.domain = ""
+    ops.version = OPSET
+    graph = model.graph
+    graph.name = "mxnet_tpu_graph"
+    ctx = _Ctx(graph)
+
+    nodes = _topo(sym)
+    if isinstance(input_shapes, dict):
+        shape_map = dict(input_shapes)
+    else:
+        shape_map = None
+        shapes_list = [tuple(s) for s in (
+            input_shapes if isinstance(input_shapes[0], (list, tuple))
+            else [input_shapes])]
+    free_vars = [n for n in nodes
+                 if n.kind == "var" and n.name not in clean]
+    if shape_map is None and len(free_vars) != len(shapes_list):
+        raise ValueError(
+            "export_model: %d input shapes given for %d free inputs (%s)"
+            % (len(shapes_list), len(free_vars),
+               [v.name for v in free_vars]))
+    free_idx = 0
+    onnx_dt = _NP_TO_ONNX[str(_np.dtype(input_type))]
+    for n in nodes:
+        if n.kind != "var":
+            continue
+        if n.name in clean:
+            graph.initializer.append(_tensor(n.name, clean[n.name]))
+        else:
+            vi = graph.input.add()
+            vi.name = n.name
+            vi.type.tensor_type.elem_type = onnx_dt
+            shp = (shape_map.get(n.name) if shape_map is not None
+                   else shapes_list[free_idx])
+            free_idx += 1
+            for s in shp:
+                d = vi.type.tensor_type.shape.dim.add()
+                d.dim_value = int(s)
+
+    for n in nodes:
+        if n.kind != "op":
+            continue
+        conv = _CONVERTERS.get(n.op)
+        if conv is None:
+            raise NotImplementedError(
+                "ONNX export: no converter for op %r (supported: %s)"
+                % (n.op, sorted(_CONVERTERS)))
+        from ...symbol.symbol import Symbol
+        ins = [ctx.out_name(x) if isinstance(x, Symbol) else ctx.const(x)
+               for x in n.inputs]
+        conv(n, ins, ctx.out_name(n), ctx)
+        if verbose:
+            print("converted %s -> %s" % (n.op, ctx.out_name(n)))
+
+    for h in sym._heads():
+        vo = graph.output.add()
+        vo.name = ctx.out_name(h)
+        vo.type.tensor_type.elem_type = onnx_dt
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

@@ -1,0 +1,284 @@
+"""ONNX ModelProto -> (Symbol, arg_params, aux_params).
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py + the
+_convert_map.  Parses through the vendored minimal schema — no onnx
+package needed — and rebuilds a Symbol graph with mx.sym builders, so the
+imported model runs through the ordinary Executor / SymbolBlock path.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import onnx_minimal_pb2 as O
+
+_ONNX_TO_NP = {1: _np.float32, 2: _np.uint8, 3: _np.int8, 6: _np.int32,
+               7: _np.int64, 9: _np.bool_, 10: _np.float16,
+               11: _np.float64}
+
+
+def _tensor_to_np(t):
+    dt = _ONNX_TO_NP.get(t.data_type, _np.float32)
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return _np.frombuffer(t.raw_data, dt).reshape(shape).copy()
+    if t.float_data:
+        return _np.asarray(t.float_data, dt).reshape(shape)
+    if t.int64_data:
+        return _np.asarray(t.int64_data, dt).reshape(shape)
+    if t.int32_data:
+        return _np.asarray(t.int32_data, dt).reshape(shape)
+    return _np.zeros(shape, dt)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = a.f
+        elif a.type == 2:
+            out[a.name] = a.i
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 4:
+            out[a.name] = _tensor_to_np(a.t)
+        elif a.type == 6:
+            out[a.name] = list(a.floats)
+        elif a.type == 7:
+            out[a.name] = list(a.ints)
+        elif a.type == 8:
+            out[a.name] = [s.decode() for s in a.strings]
+    return out
+
+
+def _halve_pads(pads):
+    if not pads:
+        return None
+    k = len(pads) // 2
+    begin, end = pads[:k], pads[k:]
+    if list(begin) != list(end):
+        raise NotImplementedError("asymmetric ONNX pads %r" % (pads,))
+    return list(begin)
+
+
+def _imp_conv(node, sym_ins, at, mx, shapes):
+    kernel = at["kernel_shape"]
+    kw = dict(kernel=tuple(kernel),
+              stride=tuple(at.get("strides", [1] * len(kernel))),
+              dilate=tuple(at.get("dilations", [1] * len(kernel))),
+              pad=tuple(_halve_pads(at.get("pads")) or [0] * len(kernel)),
+              num_group=int(at.get("group", 1)),
+              no_bias=len(sym_ins) < 3)
+    w_shape = shapes.get(node.input[1])
+    kw["num_filter"] = int(w_shape[0]) if w_shape else 0
+    return mx.sym.Convolution(*sym_ins, **kw)
+
+
+def _imp_gemm(node, sym_ins, at, mx, shapes):
+    if int(at.get("transB", 0)) != 1 or at.get("alpha", 1.0) != 1.0 or \
+            at.get("beta", 1.0) != 1.0:
+        raise NotImplementedError("Gemm with nonstandard alpha/beta/trans")
+    w_shape = shapes.get(node.input[1])
+    return mx.sym.FullyConnected(
+        *sym_ins, num_hidden=int(w_shape[0]) if w_shape else 0,
+        no_bias=len(sym_ins) < 3, flatten=False)
+
+
+def _imp_bn(node, sym_ins, at, mx, shapes):
+    return mx.sym.BatchNorm(*sym_ins,
+                            eps=float(at.get("epsilon", 1e-5)),
+                            momentum=float(at.get("momentum", 0.9)),
+                            fix_gamma=False)
+
+
+def _imp_pool(op):
+    def f(node, sym_ins, at, mx, shapes):
+        if op.startswith("Global"):
+            return mx.sym.Pooling(
+                sym_ins[0], kernel=(1, 1), global_pool=True,
+                pool_type="avg" if "Average" in op else "max")
+        kernel = at["kernel_shape"]
+        return mx.sym.Pooling(
+            sym_ins[0], kernel=tuple(kernel),
+            stride=tuple(at.get("strides", [1] * len(kernel))),
+            pad=tuple(_halve_pads(at.get("pads")) or [0] * len(kernel)),
+            pool_type="avg" if op == "AveragePool" else "max",
+            # ONNX spec default EXCLUDES padding from the average (0)
+            count_include_pad=bool(at.get("count_include_pad", 0)))
+    return f
+
+
+def _imp_act(mx_act):
+    def f(node, sym_ins, at, mx, shapes):
+        return mx.sym.Activation(sym_ins[0], act_type=mx_act)
+    return f
+
+
+def _imp_binary(mx_op):
+    def f(node, sym_ins, at, mx, shapes):
+        return getattr(mx.sym, mx_op)(sym_ins[0], sym_ins[1])
+    return f
+
+
+def _imp_softmax(node, sym_ins, at, mx, shapes):
+    return mx.sym.softmax(sym_ins[0], axis=int(at.get("axis", -1)))
+
+
+def _imp_flatten(node, sym_ins, at, mx, shapes):
+    return mx.sym.Flatten(sym_ins[0])
+
+
+def _imp_identity(node, sym_ins, at, mx, shapes):
+    return mx.sym.identity(sym_ins[0])
+
+
+def _imp_concat(node, sym_ins, at, mx, shapes):
+    return mx.sym.Concat(*sym_ins, dim=int(at.get("axis", 1)))
+
+
+def _imp_reshape(node, sym_ins, at, mx, shapes):
+    shape = at.get("shape")
+    return mx.sym.Reshape(sym_ins[0], shape=tuple(int(s) for s in shape))
+
+
+def _imp_transpose(node, sym_ins, at, mx, shapes):
+    return mx.sym.transpose(sym_ins[0], axes=tuple(at.get("perm", ())))
+
+
+def _imp_leaky(node, sym_ins, at, mx, shapes):
+    return mx.sym.LeakyReLU(sym_ins[0],
+                            slope=float(at.get("alpha", 0.01)))
+
+
+def _imp_gather(node, sym_ins, at, mx, shapes):
+    w_shape = shapes.get(node.input[0])
+    return mx.sym.Embedding(
+        sym_ins[1], sym_ins[0],
+        input_dim=int(w_shape[0]) if w_shape else 0,
+        output_dim=int(w_shape[1]) if w_shape else 0)
+
+
+def _imp_cast(node, sym_ins, at, mx, shapes):
+    np_dt = _ONNX_TO_NP.get(int(at.get("to", 1)), _np.float32)
+    if _np.issubdtype(np_dt, _np.integer):
+        # an integer Cast whose only consumers are Gather is index
+        # plumbing (Embedding casts internally); any other consumer means
+        # real integer arithmetic this importer would silently break
+        consumers = shapes.get("__consumers__", {}).get(
+            node.output[0], set())
+        if consumers - {"Gather"}:
+            raise NotImplementedError(
+                "ONNX import: integer Cast consumed by %s is not "
+                "supported (only Gather index plumbing)"
+                % sorted(consumers - {"Gather"}))
+        return sym_ins[0]
+    return mx.sym.cast(sym_ins[0], dtype=_np.dtype(np_dt).name)
+
+
+_IMPORTERS = {
+    "Conv": _imp_conv,
+    "Gemm": _imp_gemm,
+    "BatchNormalization": _imp_bn,
+    "MaxPool": _imp_pool("MaxPool"),
+    "AveragePool": _imp_pool("AveragePool"),
+    "GlobalAveragePool": _imp_pool("GlobalAveragePool"),
+    "GlobalMaxPool": _imp_pool("GlobalMaxPool"),
+    "Relu": _imp_act("relu"),
+    "Sigmoid": _imp_act("sigmoid"),
+    "Tanh": _imp_act("tanh"),
+    "Softsign": _imp_act("softsign"),
+    "Softplus": _imp_act("softrelu"),
+    "Add": _imp_binary("broadcast_add"),
+    "Sub": _imp_binary("broadcast_sub"),
+    "Mul": _imp_binary("broadcast_mul"),
+    "Div": _imp_binary("broadcast_div"),
+    "Softmax": _imp_softmax,
+    "Flatten": _imp_flatten,
+    "Identity": _imp_identity,
+    "Dropout": _imp_identity,
+    "Concat": _imp_concat,
+    "Transpose": _imp_transpose,
+    "LeakyRelu": _imp_leaky,
+    "Gather": _imp_gather,
+    "Cast": _imp_cast,
+}
+
+
+def import_model(model_file):
+    """Load an ONNX file into (sym, arg_params, aux_params) — the
+    reference import_model contract (onnx2mx/import_model.py:21)."""
+    import mxnet_tpu as mx
+
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    params = {t.name: _tensor_to_np(t) for t in g.initializer}
+    tensors = {}
+    shapes = {name: tuple(arr.shape) for name, arr in params.items()}
+    consumers = {}
+    for node in g.node:
+        for i in node.input:
+            consumers.setdefault(i, set()).add(node.op_type)
+    shapes["__consumers__"] = consumers
+    for vi in g.input:
+        if vi.name in params:
+            continue
+        tensors[vi.name] = mx.sym.Variable(vi.name)
+    for name in params:
+        tensors[name] = mx.sym.Variable(name)
+
+    consts = dict(params)  # shape tensors for Reshape etc.
+    for node in g.node:
+        imp = _IMPORTERS.get(node.op_type)
+        if imp is None:
+            raise NotImplementedError(
+                "ONNX import: unsupported op %r (supported: %s)"
+                % (node.op_type, sorted(_IMPORTERS)))
+        at = _attrs(node)
+        if node.op_type == "Reshape" and len(node.input) > 1:
+            shape_t = consts.get(node.input[1])
+            if shape_t is None:
+                raise NotImplementedError("Reshape with dynamic shape")
+            at["shape"] = [int(s) for s in _np.asarray(shape_t).ravel()]
+            ins = [tensors[node.input[0]]]
+        else:
+            ins = [tensors[i] for i in node.input]
+        out = imp(node, ins, at, mx, shapes)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(node.output, outs):
+            tensors[name] = s
+
+    heads = [tensors[vo.name] for vo in g.output]
+    sym = heads[0] if len(heads) == 1 else mx.sym.Group(heads)
+
+    # split params into arg/aux by the symbol's own classification
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name, arr in params.items():
+        nd = mx.nd.array(arr)
+        (aux_params if name in aux_names else arg_params)[name] = nd
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output descriptors of an ONNX file (reference:
+    onnx2mx/import_model.py:60 get_model_metadata)."""
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def desc(vis):
+        out = []
+        for vi in vis:
+            if vi.name in inits:
+                continue
+            shape = tuple(d.dim_value for d in
+                          vi.type.tensor_type.shape.dim)
+            out.append((vi.name, shape))
+        return out
+
+    return {"input_tensor_data": desc(g.input),
+            "output_tensor_data": desc(g.output)}

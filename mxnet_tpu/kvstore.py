@@ -283,4 +283,20 @@ def create(name="local"):
              "local_allreduce_device")
     if name not in valid:
         raise ValueError("Unknown KVStore type %r" % name)
+    if name == "dist_async":
+        # Explicit, documented alias (docs/MIGRATION.md "dist_async"):
+        # the reference's async mode exists to hide straggler latency
+        # behind parameter-server staleness
+        # (src/kvstore/kvstore_dist_server.h:349-359, apply-on-push).  On a
+        # TPU pod there is no parameter server — updates ride synchronous
+        # XLA collectives over ICI, which are faster than a PS round trip —
+        # so async's staleness tradeoff buys nothing and training runs
+        # SYNCHRONOUSLY.  Convergence therefore matches dist_sync (a
+        # strictly stronger contract than async staleness).
+        import warnings
+        warnings.warn(
+            "kvstore 'dist_async' runs with SYNCHRONOUS semantics on this "
+            "backend (no parameter server; see docs/MIGRATION.md). "
+            "Convergence is dist_sync-equivalent or better.",
+            stacklevel=2)
     return KVStore(name)

@@ -52,7 +52,79 @@ def main():
     total = np.asarray(parallel.host_allreduce(local))
     np.testing.assert_allclose(total, expect)
 
+    _row_sparse_phase(mx, kv, rank, nworker)
+    _compression_phase(mx, kv, rank, nworker)
+
+    import os
+    if os.environ.get("MXTPU_TEST_DIE_RANK") == str(rank):
+        # failure-detection fixture: this rank dies mid-job; the launcher
+        # must abort the whole job promptly (reference nightly contract:
+        # worker death -> clean error, not a hung barrier)
+        print("WORKER_DYING rank=%d" % rank, flush=True)
+        os._exit(17)
+    kv.barrier()
+
     print("WORKER_OK rank=%d/%d" % (rank, nworker), flush=True)
+
+
+def _row_sparse_phase(mx, kv, rank, nworker):
+    """row_sparse across workers (reference nightly
+    dist_sync_kvstore.py: push_row_sparse/pull_row_sparse contract):
+    each worker contributes disjoint rows plus one shared row; the merged
+    store holds the exact sum and row_sparse_pull gathers only the
+    requested rows on every worker."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    N, D = 4 * nworker + 4, 3
+    kv.init("emb", mx.nd.zeros((N, D)))
+    kv.barrier()
+    own_row = 4 + rank
+    grad = RowSparseNDArray(
+        np.full((2, D), rank + 1.0, np.float32), [0, own_row], (N, D))
+    kv.push("emb", grad)
+    kv.barrier()
+    out = RowSparseNDArray(np.zeros((0, D), np.float32),
+                           np.zeros((0,), np.int32), (N, D))
+    rows = mx.nd.array(np.array([0, own_row], np.float32))
+    kv.row_sparse_pull("emb", out=out, row_ids=rows)
+    got = np.asarray(out._values)
+    exp_shared = float(sum(r + 1 for r in range(nworker)))
+    np.testing.assert_allclose(got[0], exp_shared, err_msg="shared row")
+    np.testing.assert_allclose(got[1], rank + 1.0, err_msg="own row")
+    kv.barrier()
+
+
+def _compression_phase(mx, kv, rank, nworker):
+    """2-bit gradient compression value contract across workers
+    (reference nightly dist_sync_kvstore.py compressed section): every
+    worker pushes the same sub-threshold gradient; the pulled value each
+    round must equal nworker * threshold * code_r where code_r follows
+    the single-worker error-feedback recursion — including the rounds
+    where the quantizer emits ZERO and the residual carries over."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.compression import (two_bit_compress,
+                                                two_bit_decompress)
+    thr = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": thr})
+    shp = (6,)
+    kv.init("c", mx.nd.zeros(shp))
+    kv.barrier()
+    g = np.full(shp, 0.3, np.float32)          # sub-threshold on purpose
+    res = jnp.zeros(shp)
+    fired = 0
+    for _round in range(4):
+        codes, res = two_bit_compress(jnp.asarray(g), res, thr)
+        expect = nworker * np.asarray(two_bit_decompress(codes, thr))
+        kv.push("c", mx.nd.array(g))
+        out = mx.nd.zeros(shp)
+        kv.pull("c", out=out)
+        np.testing.assert_allclose(out.asnumpy(), expect,
+                                   err_msg="round %d" % _round)
+        fired += int(np.any(expect != 0))
+        kv.barrier()
+    assert fired >= 1, "quantizer never fired across 4 rounds"
+    zero_rounds = 4 - fired
+    assert zero_rounds >= 1, \
+        "expected at least one zero-emission round for threshold 0.5/0.3"
 
 
 if __name__ == "__main__":

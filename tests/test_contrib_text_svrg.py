@@ -71,11 +71,10 @@ def test_glove_missing_file_guidance():
                              embedding_root="/tmp/definitely-missing")
 
 
-def test_onnx_gate_points_at_stablehlo():
+def test_onnx_import_missing_file_raises():
     from mxnet_tpu.contrib import onnx as monnx
-    with pytest.raises((ImportError, NotImplementedError),
-                       match="StableHLO"):
-        monnx.import_model("m.onnx")
+    with pytest.raises((IOError, OSError)):
+        monnx.import_model("/tmp/definitely-missing-model.onnx")
 
 
 def test_svrg_optimizer_correction():

@@ -40,3 +40,38 @@ def test_dist_sync_kvstore_value_exact(nworker):
     assert proc.returncode == 0, proc.stderr[-2000:]
     for rank in range(nworker):
         assert "WORKER_OK rank=%d/%d" % (rank, nworker) in proc.stdout
+
+
+def test_dist_worker_death_aborts_job_cleanly():
+    """A worker dying mid-job must fail the whole launch promptly — the
+    launcher SIGTERMs survivors instead of leaving them hung in a barrier
+    (reference: dmlc tracker failure propagation; SURVEY §5.3 failure
+    detection)."""
+    import time
+    env = _worker_env()
+    env["MXTPU_TEST_DIE_RANK"] = "1"
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "dist_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    elapsed = time.time() - t0
+    assert proc.returncode != 0, "worker death must fail the job"
+    assert "WORKER_DYING rank=1" in proc.stdout
+    assert "WORKER_OK rank=1/2" not in proc.stdout
+    # promptly: well under the suite timeout — no hung-barrier wait
+    assert elapsed < 240, "job abort took %.0fs (hung barrier?)" % elapsed
+
+
+def test_dist_async_warns_sync_semantics():
+    """dist_async is a documented alias: accepted, but runs synchronously
+    with a one-time warning (docs/MIGRATION.md; no parameter server on a
+    TPU pod, sync collectives are strictly faster)."""
+    import warnings
+    import mxnet_tpu as mx
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        kv = mx.kv.create("dist_async")
+    assert any("SYNCHRONOUS" in str(w.message) for w in rec)
+    assert kv.type == "dist_async"

@@ -1,0 +1,86 @@
+"""ONNX interchange tests.
+
+Reference contract: python/mxnet/contrib/onnx — export_model writes a
+wire-valid ONNX ModelProto and import_model rebuilds (sym, arg, aux).
+This framework vendors the (public, spec-fixed) field numbers in
+onnx_minimal.proto, so no onnx package is needed in either direction.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _export_pair(net, tmp_path, name):
+    prefix = str(tmp_path / name)
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    params = mx.nd.load(prefix + "-0000.params")
+    return sym, params
+
+
+def _roundtrip(net, x, tmp_path, name, rtol=1e-5, atol=1e-6):
+    ref = net(mx.nd.array(x)).asnumpy()
+    sym, params = _export_pair(net, tmp_path, name)
+    onnx_path = str(tmp_path / (name + ".onnx"))
+    out_path = mx.contrib.onnx.export_model(
+        sym, params, [tuple(x.shape)], onnx_file_path=onnx_path)
+    assert out_path == onnx_path and os.path.getsize(onnx_path) > 0
+    sym2, arg, aux = mx.contrib.onnx.import_model(onnx_path)
+    ex = sym2.bind(args={**{"data": mx.nd.array(x)}, **arg},
+                   aux_states=aux, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=rtol, atol=atol)
+    return onnx_path
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=8), gluon.nn.Activation("relu"),
+            gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(0).normal(size=(2, 8)).astype(np.float32)
+    _roundtrip(net, x, tmp_path, "mlp")
+
+
+def test_onnx_resnet18_roundtrip(tmp_path):
+    """Conv/BatchNorm/Pooling/residual-add graph survives the ONNX hop
+    with value parity (reference mx2onnx op translations)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(1).uniform(
+        size=(1, 3, 32, 32)).astype(np.float32)
+    _roundtrip(net, x, tmp_path, "r18", rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_metadata_and_wire_format(tmp_path):
+    """get_model_metadata reads I/O descriptors; the serialized file is a
+    valid protobuf that reparses bit-exactly."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(3, in_units=5))
+    net.initialize(mx.init.One())
+    x = np.ones((4, 5), np.float32)
+    net(mx.nd.array(x))
+    path = _roundtrip(net, x, tmp_path, "meta")
+    meta = mx.contrib.onnx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (4, 5))]
+    assert len(meta["output_tensor_data"]) == 1
+    from mxnet_tpu.contrib.onnx import onnx_minimal_pb2 as O
+    m = O.ModelProto()
+    m.ParseFromString(open(path, "rb").read())
+    assert m.producer_name == "mxnet_tpu"
+    assert m.opset_import[0].version == 13
+    assert m.SerializeToString() == open(path, "rb").read()
+
+
+def test_onnx_export_unsupported_op_is_loud(tmp_path):
+    v = mx.sym.Variable("data")
+    s = mx.sym.sort(v)  # no ONNX converter registered for sort
+    with pytest.raises(NotImplementedError, match="sort"):
+        mx.contrib.onnx.export_model(s, {}, [(2, 2)],
+                                     onnx_file_path=str(tmp_path / "x.onnx"))
+
