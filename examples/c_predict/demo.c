@@ -1,0 +1,88 @@
+/* C host demo for the mxnet_tpu C predict ABI (src/native/
+ * c_predict_api.cc) — the analog of the reference's
+ * example/image-classification/predict-cpp over c_predict_api.h.
+ *
+ * Usage: demo <artifact-prefix> <n-input-floats>
+ * Reads n floats' worth of zeros, runs the exported model, prints the
+ * first outputs.  Build/run via tests/test_native.py or:
+ *   gcc demo.c -o demo -ldl
+ *   MXTPU_C_PLATFORM=cpu PYTHONPATH=/path/to/repo ./demo prefix 8
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef int (*create_fn)(const char *, void **);
+typedef int (*setinput_fn)(void *, const float *, const long *, int);
+typedef int (*forward_fn)(void *);
+typedef int (*getshape_fn)(void *, long *, int, int *);
+typedef int (*getout_fn)(void *, float *, long);
+typedef int (*free_fn)(void *);
+typedef const char *(*err_fn)(void);
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <libpath> <prefix> <dims...>\n", argv[0]);
+    return 2;
+  }
+  void *lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  create_fn create = (create_fn)dlsym(lib, "MXTpuPredCreate");
+  setinput_fn setinput = (setinput_fn)dlsym(lib, "MXTpuPredSetInput");
+  forward_fn forward = (forward_fn)dlsym(lib, "MXTpuPredForward");
+  getshape_fn getshape = (getshape_fn)dlsym(lib, "MXTpuPredGetOutputShape");
+  getout_fn getout = (getout_fn)dlsym(lib, "MXTpuPredGetOutput");
+  free_fn freep = (free_fn)dlsym(lib, "MXTpuPredFree");
+  err_fn lasterr = (err_fn)dlsym(lib, "MXTpuGetLastError");
+  if (!create || !setinput || !forward || !getshape || !getout || !freep) {
+    fprintf(stderr, "missing symbols\n");
+    return 2;
+  }
+
+  void *h = NULL;
+  if (create(argv[2], &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", lasterr());
+    return 1;
+  }
+  long shape[8];
+  int ndim = argc - 3;
+  long total = 1;
+  for (int i = 0; i < ndim; ++i) {
+    shape[i] = atol(argv[3 + i]);
+    total *= shape[i];
+  }
+  float *input = (float *)calloc(total, sizeof(float));
+  for (long i = 0; i < total; ++i) input[i] = (float)i / (float)total;
+  if (setinput(h, input, shape, ndim) != 0 || forward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", lasterr());
+    return 1;
+  }
+  long odims[8];
+  int ondim = 0;
+  if (getshape(h, odims, 8, &ondim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", lasterr());
+    return 1;
+  }
+  long osize = 1;
+  printf("output shape:");
+  for (int i = 0; i < ondim; ++i) {
+    printf(" %ld", odims[i]);
+    osize *= odims[i];
+  }
+  printf("\n");
+  float *out = (float *)malloc(osize * sizeof(float));
+  if (getout(h, out, osize) != 0) {
+    fprintf(stderr, "getoutput failed: %s\n", lasterr());
+    return 1;
+  }
+  printf("first outputs:");
+  for (long i = 0; i < osize && i < 4; ++i) printf(" %.5f", out[i]);
+  printf("\nC_PREDICT_OK\n");
+  freep(h);
+  free(input);
+  free(out);
+  return 0;
+}

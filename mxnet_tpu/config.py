@@ -25,6 +25,7 @@ Knob = namedtuple("Knob", ["name", "env", "type", "default", "doc"])
 
 _KNOBS = {}
 _OVERRIDES = {}
+_ON_SET = {}  # knob name -> callback(value), fired after set()
 
 
 def register_knob(name, env, type_, default, doc):
@@ -67,6 +68,9 @@ def set(name, value):  # noqa: A001 — reference-parity name
     _OVERRIDES[name] = parsed
     global _EPOCH
     _EPOCH += 1
+    hook = _ON_SET.get(name)
+    if hook is not None:
+        hook(parsed)
 
 
 # Bumped by every set(): compiled-program caches that bake knob values in at
@@ -115,6 +119,37 @@ register_knob(
 register_knob(
     "dist.process_id", "MXTPU_PROCESS_ID", int, 0,
     "this process's rank in the multi-process run.")
+
+# numerics: the recorded x64 POLICY.  TPU-native default is x64 OFF —
+# float64 has no MXU path and jax truncates it to float32 (the warnings
+# numpy-parity sweeps see are that truncation).  Scripts that genuinely
+# need f64 math (host-side numerics) opt in explicitly; flipping the knob
+# calls jax.config.update("jax_enable_x64", ...), which only takes full
+# effect before arrays are created.
+register_knob(
+    "numpy.enable_x64", "MXTPU_ENABLE_X64", bool, False,
+    "enable 64-bit dtypes in the jax backend (mx.np float64/int64 stay "
+    "true 64-bit instead of truncating to 32-bit). TPU compute should "
+    "stay 32/16-bit: f64 is emulated and slow on MXU hardware.")
+
+
+def _apply_x64(value):
+    import jax
+    jax.config.update("jax_enable_x64", bool(value))
+
+
+_ON_SET["numpy.enable_x64"] = _apply_x64
+
+# honor the documented env var at import: the recorded policy and the jax
+# state must never diverge
+if os.environ.get("MXTPU_ENABLE_X64"):
+    _apply_x64(get("numpy.enable_x64"))
+
+
+def enable_x64(flag=True):
+    """Programmatic x64 switch (pairs with the numpy.enable_x64 knob)."""
+    set("numpy.enable_x64", bool(flag))
+
 
 # profiler (reference env_var.md:201-205)
 register_knob(

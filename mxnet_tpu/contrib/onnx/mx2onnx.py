@@ -285,6 +285,12 @@ def export_model(sym, params, input_shapes, input_type=_np.float32,
             "export_model: %d input shapes given for %d free inputs (%s)"
             % (len(shapes_list), len(free_vars),
                [v.name for v in free_vars]))
+    if shape_map is not None:
+        missing = [v.name for v in free_vars if v.name not in shape_map]
+        if missing:
+            raise ValueError(
+                "export_model: input_shapes dict missing free inputs %s"
+                % missing)
     free_idx = 0
     onnx_dt = _NP_TO_ONNX[str(_np.dtype(input_type))]
     for n in nodes:

@@ -150,6 +150,10 @@ def _imp_leaky(node, sym_ins, at, mx, shapes):
 
 
 def _imp_gather(node, sym_ins, at, mx, shapes):
+    if int(at.get("axis", 0)) != 0:
+        raise NotImplementedError(
+            "ONNX import: Gather with axis=%d (only axis=0 embedding "
+            "lookups are supported)" % int(at["axis"]))
     w_shape = shapes.get(node.input[0])
     return mx.sym.Embedding(
         sym_ins[1], sym_ins[0],

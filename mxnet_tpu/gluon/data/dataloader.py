@@ -50,6 +50,93 @@ def _worker_fn(samples, batchify_fn, dataset=None):
     return batchify_fn([ds[i] for i in samples])
 
 
+class _ShmDesc:
+    """Descriptor of one array parked in POSIX shared memory."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _shm_export(obj):
+    """Park every array of a batch in shared memory; return descriptors.
+
+    The reference passes worker batches through shared-memory NDArrays
+    rebuilt via ForkingPickler fd passing (dataloader.py:28-111); this is
+    the same trick over multiprocessing.shared_memory — the batch BYTES
+    never travel through the result pipe, only tiny descriptors do.
+    """
+    from multiprocessing import shared_memory, resource_tracker
+
+    def conv(x):
+        if isinstance(x, NDArray):
+            x = x.asnumpy()
+        if isinstance(x, _np.ndarray):
+            shm = shared_memory.SharedMemory(create=True,
+                                             size=max(1, x.nbytes))
+            view = _np.ndarray(x.shape, x.dtype, buffer=shm.buf)
+            view[...] = x
+            name = shm.name
+            shm.close()
+            try:
+                # ownership transfers to the consumer (which unlinks);
+                # keep this process's resource tracker from double-freeing
+                resource_tracker.unregister("/" + name, "shared_memory")
+            except Exception:  # noqa: BLE001 — tracker API is private
+                pass
+            return _ShmDesc(name, x.shape, str(x.dtype))
+        if isinstance(x, (list, tuple)):
+            return type(x)(conv(i) for i in x)
+        return x
+
+    return conv(obj)
+
+
+def _shm_import(obj):
+    """Rebuild a batch from shared-memory descriptors (consumer side):
+    map, one copy into the device/XLA buffer, unlink."""
+    from multiprocessing import shared_memory
+
+    def conv(x):
+        if isinstance(x, _ShmDesc):
+            shm = shared_memory.SharedMemory(name=x.name)
+            arr = _np.ndarray(x.shape, _np.dtype(x.dtype), buffer=shm.buf)
+            # own the bytes BEFORE unmapping: jax's CPU backend zero-copies
+            # aligned numpy buffers, so handing `arr` over directly would
+            # leave a live device array aliasing unmapped shm (segfault)
+            out = nd_array(arr.copy())
+            shm.close()
+            shm.unlink()
+            return out
+        if isinstance(x, (list, tuple)):
+            return type(x)(conv(i) for i in x)
+        return x
+
+    return conv(obj)
+
+
+def _numpy_batchify(data):
+    """default_batchify_fn's host twin: same collation, numpy output —
+    forked workers must never construct device arrays (fork + live XLA
+    runtime deadlocks; a child backend init would also grab the
+    single-client TPU tunnel).  The parent wraps the batch once."""
+    if isinstance(data[0], NDArray):
+        return _np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        return [_numpy_batchify(list(i)) for i in zip(*data)]
+    out = _np.asarray(data)
+    return out.astype(_np.float32) if out.dtype == _np.float64 else out
+
+
+def _worker_fn_shm(samples, batchify_fn, dataset=None):
+    if batchify_fn is default_batchify_fn:
+        batchify_fn = _numpy_batchify
+    return _shm_export(_worker_fn(samples, batchify_fn, dataset))
+
+
 class DataLoader:
     """Loads data from a dataset and returns mini-batches
     (reference: dataloader.py:534)."""
@@ -98,9 +185,13 @@ class DataLoader:
                 self._pool = ThreadPoolExecutor(max_workers=self._num_workers)
             else:
                 ctx = multiprocessing.get_context("fork")
+                # snapshot to host BEFORE forking: children index numpy,
+                # never the jax runtime (see Dataset.host_view)
+                host_ds = dataset.host_view() if hasattr(
+                    dataset, "host_view") else dataset
                 self._pool = ProcessPoolExecutor(
                     max_workers=self._num_workers, mp_context=ctx,
-                    initializer=_worker_initializer, initargs=(dataset,))
+                    initializer=_worker_initializer, initargs=(host_ds,))
 
     def __iter__(self):
         if self._num_workers == 0:
@@ -136,8 +227,10 @@ class _PrefetchIter:
         batch = next(self._iter, None)
         if batch is None:
             return
-        fut = self._loader._pool.submit(
-            _worker_fn, batch, *self._submit_args)
+        # process workers hand batches over via shared memory (fd-passing
+        # analog, reference dataloader.py:28-111); threads share the heap
+        fn = _worker_fn if self._loader._thread_pool else _worker_fn_shm
+        fut = self._loader._pool.submit(fn, batch, *self._submit_args)
         self._pending.append(fut)
 
     def __iter__(self):
@@ -148,4 +241,40 @@ class _PrefetchIter:
             raise StopIteration
         fut = self._pending.pop(0)
         self._push_next()
-        return fut.result(timeout=self._loader._timeout)
+        out = fut.result(timeout=self._loader._timeout)
+        if not self._loader._thread_pool:
+            out = _shm_import(out)
+        return out
+
+    def close(self):
+        """Drain abandoned prefetches: every exported shm segment must be
+        unlinked even if the consumer never imported it (early `break`,
+        exception) — otherwise /dev/shm leaks until reboot."""
+        pending, self._pending = self._pending, []
+        if self._loader._thread_pool:
+            return
+        from multiprocessing import shared_memory
+
+        def unlink(obj):
+            if isinstance(obj, _ShmDesc):
+                try:
+                    shm = shared_memory.SharedMemory(name=obj.name)
+                    shm.close()
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+            elif isinstance(obj, (list, tuple)):
+                for o in obj:
+                    unlink(o)
+
+        for fut in pending:
+            try:
+                unlink(fut.result(timeout=self._loader._timeout))
+            except Exception:  # noqa: BLE001 — worker died; nothing to free
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -28,6 +28,13 @@ class Dataset:
         indices = [i for i in range(len(self)) if fn(self[i])]
         return _SampledDataset(self, indices)
 
+    def host_view(self):
+        """Hook for process-pool DataLoader workers: return an equivalent
+        dataset producing host (numpy) items.  Default: self — datasets
+        whose __getitem__ already avoids device arrays (files, PIL, numpy)
+        are fork-safe as-is."""
+        return self
+
     def shard(self, num_shards, index):
         """Returns a shard of the dataset (reference: dataset.py:71).
 
@@ -143,6 +150,27 @@ class ArrayDataset(Dataset):
 
     def __len__(self):
         return self._length
+
+    def host_view(self):
+        """Equivalent dataset whose items are host numpy — what a forked
+        DataLoader worker indexes (children must never touch the jax
+        runtime: forked XLA state deadlocks, and on this platform a child
+        backend init would grab the single-client TPU tunnel)."""
+        import numpy as _host_np
+
+        def host(d):
+            if isinstance(d, NDArray):
+                return d.asnumpy()
+            if isinstance(d, list):
+                # convert ELEMENTS too: a device array inside a list column
+                # would re-create the fork hazard this method removes
+                return [host(x) for x in d]
+            return _host_np.asarray(d)
+
+        out = ArrayDataset.__new__(ArrayDataset)
+        out._length = self._length
+        out._data = [host(d) for d in self._data]
+        return out
 
 
 class RecordFileDataset(Dataset):

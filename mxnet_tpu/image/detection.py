@@ -1,0 +1,257 @@
+"""Detection augmenters: joint image + bounding-box transforms.
+
+Reference: python/mxnet/image/detection.py (DetBorrowAug,
+DetRandomSelectAug, DetHorizontalFlipAug, DetRandomCropAug,
+DetRandomPadAug, CreateDetAugmenter) over
+src/io/image_det_aug_default.cc.
+
+Labels are (N, 5+) float arrays, rows ``[cls, x1, y1, x2, y2, ...]`` with
+corner coordinates NORMALIZED to [0, 1] — the reference's det-label
+layout.  Every augmenter maps ``(src, label) -> (src, label)``; images are
+host numpy/NDArray HWC like the classification augmenters (host-side data
+pipeline, device sees only the batched output).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .image import Augmenter, _to_np, _wrap, fixed_crop
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter"]
+
+
+class DetAugmenter:
+    """Base detection augmenter (reference: detection.py DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection chain (labels pass
+    through untouched) — reference detection.py:70."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.__class__.__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly apply one of `aug_list` (or none, with skip_prob) —
+    reference detection.py:84."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and mirror the box x-coordinates — reference
+    detection.py:103."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src)[:, ::-1, :]
+            label = _np.array(label, _np.float32, copy=True)
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            x2 = label[valid, 3].copy()
+            label[valid, 1] = 1.0 - x2
+            label[valid, 3] = 1.0 - x1
+            return _wrap(jnp.asarray(arr.copy())), label
+        return src, label
+
+
+def _box_iou_1d(crop, boxes):
+    """IoU of one crop box vs (N,4) boxes, all normalized corners."""
+    tl = _np.maximum(crop[:2], boxes[:, :2])
+    br = _np.minimum(crop[2:], boxes[:, 2:4])
+    wh = _np.clip(br - tl, 0, None)
+    inter = wh[:, 0] * wh[:, 1]
+    area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
+    return inter / _np.maximum(area_b + area_c - inter, 1e-12)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """SSD-style constrained random crop (reference detection.py:161 /
+    image_det_aug_default.cc): sample crops until one has IoU with some
+    object >= min_object_covered; boxes are clipped/renormalized and
+    fully-outside objects are dropped (marked cls=-1 to keep row count
+    static for batching)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _sample_crop(self, label):
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ar = _pyrandom.uniform(*self.aspect_ratio_range)
+            w = min(_np.sqrt(area * ar), 1.0)
+            h = min(_np.sqrt(area / ar), 1.0)
+            x0 = _pyrandom.uniform(0, 1 - w)
+            y0 = _pyrandom.uniform(0, 1 - h)
+            crop = _np.array([x0, y0, x0 + w, y0 + h], _np.float32)
+            valid = label[:, 0] >= 0
+            if not valid.any():
+                return crop
+            iou = _box_iou_1d(crop, label[valid, 1:5])
+            if iou.max() >= self.min_object_covered:
+                return crop
+        return None
+
+    def __call__(self, src, label):
+        label = _np.array(label, _np.float32, copy=True)
+        crop = self._sample_crop(label)
+        if crop is None:
+            return src, label
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        x0, y0, x1, y1 = crop
+        px0, py0 = int(x0 * w), int(y0 * h)
+        pw = max(1, int((x1 - x0) * w))
+        ph = max(1, int((y1 - y0) * h))
+        out = fixed_crop(arr, px0, py0, pw, ph, None, 2)
+        cw, ch = x1 - x0, y1 - y0
+        valid = label[:, 0] >= 0
+        b = label[valid, 1:5]
+        b[:, [0, 2]] = (b[:, [0, 2]] - x0) / cw
+        b[:, [1, 3]] = (b[:, [1, 3]] - y0) / ch
+        clipped = _np.clip(b, 0.0, 1.0)
+        # drop objects whose center left the crop (reference center rule)
+        cx = (b[:, 0] + b[:, 2]) / 2
+        cy = (b[:, 1] + b[:, 3]) / 2
+        keep = (cx > 0) & (cx < 1) & (cy > 0) & (cy < 1)
+        label[valid, 1:5] = clipped
+        cls = label[valid, 0]
+        cls[~keep] = -1.0
+        label[valid, 0] = cls
+        return out, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out pad (reference detection.py:280): place the image on a
+    larger canvas filled with `fill`, shrinking the boxes accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        label = _np.array(label, _np.float32, copy=True)
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(*self.area_range)
+            ar = _pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(w * _np.sqrt(scale * ar))
+            nh = int(h * _np.sqrt(scale / ar))
+            if nw >= w and nh >= h:
+                break
+        else:
+            return src, label
+        x0 = _pyrandom.randint(0, nw - w)
+        y0 = _pyrandom.randint(0, nh - h)
+        canvas = _np.empty((nh, nw, arr.shape[2]), arr.dtype)
+        canvas[...] = _np.asarray(self.pad_val, arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w, :] = arr
+        valid = label[:, 0] >= 0
+        b = label[valid, 1:5]
+        b[:, [0, 2]] = (b[:, [0, 2]] * w + x0) / nw
+        b[:, [1, 3]] = (b[:, [1, 3]] * h + y0) / nh
+        label[valid, 1:5] = b
+        return _wrap(jnp.asarray(canvas)), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Detection augmenter chain factory (reference: detection.py:342
+    CreateDetAugmenter — same knob set and ordering)."""
+    from .image import (ResizeAug, ForceResizeAug, CastAug,
+                        ColorJitterAug, HueJitterAug, RandomGrayAug,
+                        LightingAug, ColorNormalizeAug)
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(1.0, area_range[0]), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force final shape AFTER the geometric augs (reference ordering)
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist

@@ -27,7 +27,8 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "ResizeAug", "ForceResizeAug", "CenterCropAug", "RandomCropAug",
            "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
            "LightingAug", "ColorJitterAug", "RandomOrderAug", "Augmenter",
-           "CreateAugmenter", "ImageIter", "scale_down"]
+           "HueJitterAug", "RandomGrayAug", "RandomSizedCropAug",
+           "SequentialAug", "CreateAugmenter", "ImageIter", "scale_down"]
 
 
 def _to_np(img):
@@ -292,6 +293,82 @@ class ColorJitterAug(Augmenter):
         return src
 
 
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (reference: image.py
+    HueJitterAug — same Gray-world rotation matrix construction)."""
+
+    _yiq = _np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], _np.float32)
+    _yiq_inv = _np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(_np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        rot = _np.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w],
+                         [0.0, w, u]], _np.float32)
+        t = self._yiq_inv @ rot @ self._yiq
+        return _wrap(jnp.asarray(arr @ t.T))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel grayscale (reference: image.py
+    RandomGrayAug)."""
+
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src).astype(_np.float32)
+            gray = (arr * self._coef).sum(axis=2, keepdims=True)
+            return _wrap(jnp.asarray(_np.broadcast_to(
+                gray, arr.shape).copy()))
+        return src
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop resized to `size` (reference: image.py
+    RandomSizedCropAug — the Inception-style crop)."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class SequentialAug(Augmenter):
+    """Apply a fixed sequence of augmenters (reference: image.py
+    SequentialAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
 class RandomOrderAug(Augmenter):
     def __init__(self, ts):
         super().__init__()
@@ -307,8 +384,8 @@ class RandomOrderAug(Augmenter):
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
-                    inter_method=2):
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
     """Standard augmenter chain factory (reference: mx.image.CreateAugmenter
     / image_aug_default.cc defaults)."""
     auglist = []
@@ -316,10 +393,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
-        auglist.append(type("RandSizeCrop", (Augmenter,), {
-            "__call__": lambda self, src: random_size_crop(
-                src, crop_size, (0.08, 1.0), (3 / 4., 4 / 3.),
-                inter_method)[0]})())
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4., 4 / 3.), inter_method))
     elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
@@ -329,6 +404,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if pca_noise > 0:
         eigval = [55.46, 4.794, 1.148]
         eigvec = [[-0.5675, 0.7192, 0.4009],

@@ -134,6 +134,38 @@ _SEQ_APIS = {"stack", "concatenate", "vstack", "hstack", "dstack",
 
 _CACHE = {}
 
+# (name, input dtypes, attr signature) -> should the call tape a vjp?
+# Decides by the OUTPUT dtype via jax.eval_shape (abstract trace, no
+# execution): integer/bool-output functions must never be taped — jax.vjp
+# rejects them — and a hand-list (_NONDIFF above, kept as the fast path)
+# can never enumerate all of jnp.
+_DIFF_CACHE = {}
+
+
+def _output_is_inexact(name, target, arrs, kwargs):
+    key = (name,
+           tuple(str(getattr(a, "dtype", type(a).__name__)) for a in arrs),
+           tuple(sorted((k, str(v)) for k, v in kwargs.items())))
+    hit = _DIFF_CACHE.get(key)
+    if hit is not None:
+        return hit
+    try:
+        out = jax.eval_shape(lambda *a: target(*a, **kwargs), *arrs)
+        leaves = jax.tree_util.tree_leaves(out)
+        ok = any(jnp.issubdtype(l.dtype, jnp.inexact) for l in leaves)
+    except Exception:  # noqa: BLE001 — undecidable: keep default taping
+        ok = True
+    _DIFF_CACHE[key] = ok
+    return ok
+
+
+def __dir__():
+    # discoverability contract (dir(mx.np), import *): local names plus
+    # the full delegated jnp surface
+    names = set(globals()) | set(__all__)
+    names.update(n for n in dir(jnp) if not n.startswith("_"))
+    return sorted(names)
+
 
 def __getattr__(name):
     if name.startswith("_"):
@@ -167,13 +199,21 @@ def __getattr__(name):
         op = Operator("np." + name,
                       lambda *a, **kw: target(*a, **kw),
                       differentiable=name not in _NONDIFF)
+        op_notape = Operator("np." + name, op.fn, differentiable=False)
 
         def fn(*args, **kwargs):
             # positional NDArrays stay wrapped so apply_op tapes them for
             # autograd; keyword values (axis=, where=...) are attrs
+            from .. import _tape
             kwargs = {k: (v._data if isinstance(v, NDArray) else v)
                       for k, v in kwargs.items()}
-            return apply_op(op, *args, **kwargs)
+            use = op
+            if op.differentiable and _tape.is_recording():
+                arrs = tuple(a._data if isinstance(a, NDArray) else a
+                             for a in args)
+                if not _output_is_inexact(name, target, arrs, kwargs):
+                    use = op_notape
+            return apply_op(use, *args, **kwargs)
 
     fn.__name__ = name
     fn.__qualname__ = "mx.np." + name

@@ -321,7 +321,7 @@ def _make_slices(d, begin, end, step):
 @register("fix")
 def _fix(data, **_):
     """Round toward zero (reference elemwise_unary_op_basic.cc fix)."""
-    return jnp.fix(jnp.asarray(data))
+    return jnp.trunc(jnp.asarray(data))
 
 
 @register("_unravel_index", aliases=("unravel_index",),

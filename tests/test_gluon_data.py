@@ -86,3 +86,42 @@ def test_compose_pipeline():
     out = pipe(img)
     assert out.shape == (3, 8, 8)
     assert float(out.asnumpy().max()) <= 1.0
+
+
+def test_dataloader_process_pool_shared_memory():
+    """Process-pool workers hand batches over via shared memory (the
+    ForkingPickler fd-passing analog, reference dataloader.py:28-111):
+    values are exact, every segment is unlinked after use, and nested
+    (data, label) structures survive."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    Y = np.arange(16, dtype=np.float32)
+    ds = ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    import glob
+    before = set(glob.glob("/dev/shm/psm_*"))
+    loader = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=False)
+    seen = []
+    for data, label in loader:
+        assert data.shape == (4, 4) and label.shape == (4,)
+        seen.append((data.asnumpy(), label.asnumpy()))
+    got_X = np.concatenate([d for d, _ in seen])
+    got_Y = np.concatenate([l for _, l in seen])
+    np.testing.assert_array_equal(got_X, X)
+    np.testing.assert_array_equal(got_Y, Y)
+    # no leaked segments from our transfer (compare against a pre-loop
+    # snapshot: other processes' psm_* segments are not ours to judge)
+    import glob
+    after = set(glob.glob("/dev/shm/psm_*"))
+    leaks = after - before
+    assert not leaks, "leaked shared-memory segments: %s" % leaks
+
+    # abandoning the iterator (early break) must not leak prefetches
+    before2 = set(glob.glob("/dev/shm/psm_*"))
+    it = iter(loader)
+    next(it)
+    it.close()
+    del it
+    import gc
+    gc.collect()
+    after2 = set(glob.glob("/dev/shm/psm_*"))
+    assert not (after2 - before2), "abandoned prefetch leaked segments"

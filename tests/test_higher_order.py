@@ -116,3 +116,19 @@ def test_first_order_unchanged():
     g = autograd.grad(y, x, retain_graph=True)[0]
     y.backward()
     np.testing.assert_allclose(g.asnumpy(), x.grad.asnumpy(), rtol=1e-6)
+
+
+def test_regrad_of_detached_grad_is_not_silent_zero():
+    """Re-recording on a detached grad output then backward must produce
+    the correct gradient, not silent zeros (round-2 advisor finding):
+    g = dy/dx detaches, then d(g*x)/dx == g as a constant."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, [x], create_graph=False)[0]
+    np.testing.assert_allclose(g.asnumpy(), [4.0])
+    with autograd.record():
+        z = (g * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])

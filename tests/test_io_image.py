@@ -211,3 +211,75 @@ def test_imagerecorditer_seed_and_round_batch(tmp_path):
     it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=4,
                                data_shape=(3, 16, 16), round_batch=False)
     assert sum(1 for _ in it) == 2
+
+
+def test_hue_gray_randsized_augmenters():
+    """Round-4 breadth: HueJitterAug (YIQ rotation preserves luma-ish
+    energy), RandomGrayAug (all channels equal when it fires),
+    RandomSizedCropAug and SequentialAug (reference image.py classes)."""
+    rng = np.random.RandomState(0)
+    img = rng.uniform(0, 255, (32, 32, 3)).astype(np.float32)
+    out = mx.image.HueJitterAug(0.3)(img).asnumpy()
+    assert out.shape == img.shape
+    assert not np.allclose(out, img)  # rotated
+    # zero hue ~= identity (the standard rounded YIQ constants are an
+    # approximate inverse pair: ~0.3% on a 0-255 scale)
+    np.testing.assert_allclose(
+        mx.image.HueJitterAug(0.0)(img).asnumpy(), img, rtol=2e-2,
+        atol=1.0)
+    g = mx.image.RandomGrayAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+    np.testing.assert_allclose(g[..., 1], g[..., 2], rtol=1e-5)
+    c = mx.image.RandomSizedCropAug((16, 16), (0.5, 1.0),
+                                    (0.75, 1.33))(img)
+    assert c.asnumpy().shape[:2] == (16, 16)
+    seq = mx.image.SequentialAug([mx.image.CastAug(),
+                                  mx.image.RandomGrayAug(1.0)])
+    s = seq(img).asnumpy()
+    np.testing.assert_allclose(s[..., 0], s[..., 2], rtol=1e-5)
+    augs = mx.image.CreateAugmenter((3, 16, 16), hue=0.1, rand_gray=0.2)
+    names = [a.__class__.__name__ for a in augs]
+    assert "HueJitterAug" in names and "RandomGrayAug" in names
+
+
+def test_detection_augmenters():
+    """Detection chain (reference detection.py / image_det_aug_default.cc):
+    flip mirrors boxes exactly, crop keeps covered objects with
+    renormalized coordinates, pad shrinks boxes onto the canvas, and
+    CreateDetAugmenter assembles the documented chain."""
+    rng = np.random.RandomState(1)
+    img = rng.uniform(0, 255, (40, 60, 3)).astype(np.float32)
+    label = np.array([[1, 0.1, 0.2, 0.5, 0.8],
+                      [2, 0.6, 0.1, 0.9, 0.4],
+                      [-1, 0, 0, 0, 0]], np.float32)  # padded row
+
+    out, lab = mx.image.DetHorizontalFlipAug(1.0)(img, label)
+    np.testing.assert_allclose(lab[0, 1:5], [0.5, 0.2, 0.9, 0.8],
+                               rtol=1e-6)
+    np.testing.assert_allclose(lab[2], label[2])  # padding untouched
+    np.testing.assert_array_equal(out.asnumpy(), img[:, ::-1, :])
+
+    crop = mx.image.DetRandomCropAug(min_object_covered=0.3,
+                                     area_range=(0.5, 1.0))
+    out, lab = crop(img, label)
+    valid = lab[lab[:, 0] >= 0]
+    assert (valid[:, 1:5] >= -1e-6).all() and (valid[:, 1:5] <= 1 + 1e-6).all()
+
+    pad = mx.image.DetRandomPadAug(area_range=(1.5, 2.0))
+    out, lab = pad(img, label)
+    oh, ow = out.asnumpy().shape[:2]
+    assert oh >= 40 and ow >= 60
+    w0 = (label[0, 3] - label[0, 1])
+    w1 = (lab[0, 3] - lab[0, 1])
+    assert w1 < w0  # boxes shrink on the larger canvas
+
+    chain = mx.image.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                        rand_pad=0.5, rand_mirror=True,
+                                        brightness=0.1, hue=0.05,
+                                        mean=True, std=True)
+    src, lab = img, label
+    for aug in chain:
+        src, lab = aug(src, lab)
+    from mxnet_tpu.image.image import _to_np
+    assert _to_np(src).shape == (32, 32, 3)
+    assert lab.shape == label.shape

