@@ -60,15 +60,19 @@ def set(name, value):  # noqa: A001 — reference-parity name
     # set('x', '0') and ENV_X=0 agree (notably for bools)
     parsed = _parse(knob, value) if isinstance(value, str) \
         else knob.type(value)
+    hook = _ON_SET.get(name)
     if parsed == get(name):
         # no-op set (same as current override/env/default): don't
-        # invalidate compiled-program caches
+        # invalidate compiled-program caches — but DO re-fire the side-
+        # effect hook, so external state a hook mirrors (jax_enable_x64)
+        # re-syncs even if someone flipped it behind the knob's back
         _OVERRIDES[name] = parsed
+        if hook is not None:
+            hook(parsed)
         return
     _OVERRIDES[name] = parsed
     global _EPOCH
     _EPOCH += 1
-    hook = _ON_SET.get(name)
     if hook is not None:
         hook(parsed)
 
@@ -150,6 +154,16 @@ def enable_x64(flag=True):
     """Programmatic x64 switch (pairs with the numpy.enable_x64 knob)."""
     set("numpy.enable_x64", bool(flag))
 
+
+# conv internal layout experiment (docs/PERF_NOTES.md): "native" keeps the
+# NCHW dimension numbers; "NHWC" transposes inside the Convolution lowering
+# so channels ride the TPU lane dimension (XLA cancels the transposes
+# between adjacent convs).  Knob-gated because the win is model-shape
+# dependent; bench.py sweeps both.
+register_knob(
+    "conv.internal_layout", "MXTPU_CONV_LAYOUT", str, "native",
+    "internal conv layout: native (NCHW dimension numbers) or NHWC "
+    "(channels-last inside the lowering; logical API stays NCHW).")
 
 # profiler (reference env_var.md:201-205)
 register_knob(

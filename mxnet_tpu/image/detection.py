@@ -87,15 +87,17 @@ class DetHorizontalFlipAug(DetAugmenter):
         return src, label
 
 
-def _box_iou_1d(crop, boxes):
-    """IoU of one crop box vs (N,4) boxes, all normalized corners."""
+def _box_coverage(crop, boxes):
+    """Object coverage of one crop vs (N,4) boxes: intersection over BOX
+    area (the reference's min_object_covered semantics,
+    image_det_aug_default.cc — NOT IoU, which would starve small
+    objects)."""
     tl = _np.maximum(crop[:2], boxes[:, :2])
     br = _np.minimum(crop[2:], boxes[:, 2:4])
     wh = _np.clip(br - tl, 0, None)
     inter = wh[:, 0] * wh[:, 1]
     area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
-    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
-    return inter / _np.maximum(area_b + area_c - inter, 1e-12)
+    return inter / _np.maximum(area_b, 1e-12)
 
 
 class DetRandomCropAug(DetAugmenter):
@@ -127,8 +129,8 @@ class DetRandomCropAug(DetAugmenter):
             valid = label[:, 0] >= 0
             if not valid.any():
                 return crop
-            iou = _box_iou_1d(crop, label[valid, 1:5])
-            if iou.max() >= self.min_object_covered:
+            cov = _box_coverage(crop, label[valid, 1:5])
+            if cov.max() >= self.min_object_covered:
                 return crop
         return None
 

@@ -142,10 +142,27 @@ _CACHE = {}
 _DIFF_CACHE = {}
 
 
+def _sig_part(v):
+    """Cache-key element for one argument: precise for dtype-carrying and
+    plain-python values (a positional dtype string must NOT collapse to
+    'str'), cheap for arrays (dtype+shape only — never stringify a buffer,
+    that would sync the device and grow the cache per content)."""
+    dt = getattr(v, "dtype", None)
+    if dt is not None:
+        return ("a", str(dt), tuple(getattr(v, "shape", ())))
+    if isinstance(v, (str, int, float, bool, complex, type(None))):
+        return ("v", v)
+    if isinstance(v, type):
+        return ("t", getattr(v, "__name__", str(v)))
+    if isinstance(v, (list, tuple)):
+        return ("s",) + tuple(_sig_part(x) for x in v)
+    return ("o", type(v).__name__)
+
+
 def _output_is_inexact(name, target, arrs, kwargs):
     key = (name,
-           tuple(str(getattr(a, "dtype", type(a).__name__)) for a in arrs),
-           tuple(sorted((k, str(v)) for k, v in kwargs.items())))
+           tuple(_sig_part(a) for a in arrs),
+           tuple(sorted((k, _sig_part(v)) for k, v in kwargs.items())))
     hit = _DIFF_CACHE.get(key)
     if hit is not None:
         return hit

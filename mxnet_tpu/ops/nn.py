@@ -60,15 +60,35 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate, ndim)
     pad = _tup(pad if pad is not None else 0, ndim)
     pad = pad if isinstance(pad[0], tuple) else tuple((p, p) for p in pad)
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
+    nhwc = ndim == 2 and _nhwc_internal()
+    if nhwc:
+        # channels-LAST internal layout (docs/PERF_NOTES.md): channels map
+        # to the TPU's 128-lane minor dimension, which is where the
+        # HBM-bound 1x1 convs of a ResNet want them.  The logical API
+        # stays NCHW; XLA cancels the transposes between back-to-back
+        # convs, so only the graph edges pay a relayout.
+        xin = jnp.transpose(x, (0, 2, 3, 1))
+        dn = lax.conv_dimension_numbers(xin.shape, w.shape,
+                                        ("NHWC", "OIHW", "NHWC"))
+    else:
+        xin = x
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
     out = lax.conv_general_dilated(
-        x, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
+        xin, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32
+        else None)
+    if nhwc:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     out = out.astype(x.dtype)
     if bias is not None and not no_bias:
         out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
     return out
+
+
+def _nhwc_internal():
+    from .. import config as _config
+    return _config.get("conv.internal_layout") == "NHWC"
 
 
 @register("Deconvolution", aliases=("deconvolution",))

@@ -207,7 +207,12 @@ int MXTpuPredGetOutputShape(void *handle, long *dims, int max_ndim,
   }
   Py_ssize_t n = PyTuple_Size(shape);
   *out_ndim = static_cast<int>(n);
-  for (Py_ssize_t i = 0; i < n && i < max_ndim; ++i) {
+  if (n > max_ndim) {
+    Py_DECREF(shape);
+    set_error("MXTpuPredGetOutputShape: dims buffer too small");
+    return -1;  // caller sees the required ndim in *out_ndim
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
     dims[i] = PyLong_AsLong(PyTuple_GetItem(shape, i));
   }
   Py_DECREF(shape);

@@ -164,3 +164,21 @@ def test_fused_rnn_initializer():
     w = _wrap(jnp.zeros((16, 8)))
     init(InitDesc("lstm_l0_i2h_weight"), w)
     assert float(np.abs(w.asnumpy()).sum()) > 0  # inner init applied
+
+
+def test_conv_internal_layout_nhwc_parity():
+    """The conv.internal_layout=NHWC experiment (docs/PERF_NOTES.md) is
+    numerically identical to the native lowering — including grouped
+    convs — so the bench can sweep it safely."""
+    from mxnet_tpu import gluon
+    net = gluon.nn.Conv2D(8, 3, padding=1, in_channels=3)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).rand(
+        2, 3, 16, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    mx.config.set("conv.internal_layout", "NHWC")
+    try:
+        out = net(x).asnumpy()
+    finally:
+        mx.config.set("conv.internal_layout", "native")
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-5)
