@@ -272,10 +272,24 @@ class Parameter:
         self._data._data = jnp.asarray(val, self._data._data.dtype)
 
     def row_sparse_data(self, row_id):
-        return self.data()
+        """Only the requested rows of a row_sparse parameter (reference:
+        parameter.py:525 — the kvstore row_sparse_pull path).  Returns a
+        lazy RowSparseNDArray holding the K gathered rows; dense
+        parameters return the full array like the reference does when
+        stype is default."""
+        if self._stype != "row_sparse" and self._grad_stype != "row_sparse":
+            return self.data()
+        from ..ndarray.sparse import RowSparseNDArray
+        from ..ndarray.ndarray import NDArray
+        rows = jnp.asarray(
+            row_id._data if isinstance(row_id, NDArray) else row_id
+        ).astype(jnp.int32).ravel()
+        full = self.data()._data
+        return RowSparseNDArray(full[rows], rows, tuple(full.shape))
 
     def list_row_sparse_data(self, row_id):
-        return self.list_data()
+        return [self.row_sparse_data(row_id)] * max(
+            1, len(self._ctx_list or []))
 
     def data(self, ctx=None):
         """Return a (the) copy of this parameter (reference: parameter.py:493)."""
