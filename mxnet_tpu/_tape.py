@@ -75,10 +75,10 @@ class Node:
     """
 
     __slots__ = ("inputs", "outputs", "vjp_fn", "name", "_visited",
-                 "primal_fn", "primal_multi")
+                 "primal_fn", "primal_multi", "hogr_error")
 
     def __init__(self, inputs, outputs, vjp_fn, name="", primal_fn=None,
-                 primal_multi=False):
+                 primal_multi=False, hogr_error=None):
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.vjp_fn = vjp_fn
@@ -86,12 +86,16 @@ class Node:
         self._visited = False
         self.primal_fn = primal_fn
         self.primal_multi = primal_multi
+        # set → this node cannot participate in create_graph=True: raising
+        # beats the silent zero higher-order grads it would produce
+        self.hogr_error = hogr_error
 
 
 def record_node(inputs, outputs, vjp_fn, name="", primal_fn=None,
-                primal_multi=False) -> Node:
+                primal_multi=False, hogr_error=None) -> Node:
     """Attach a new tape node to its output arrays."""
-    node = Node(inputs, outputs, vjp_fn, name, primal_fn, primal_multi)
+    node = Node(inputs, outputs, vjp_fn, name, primal_fn, primal_multi,
+                hogr_error)
     for i, out in enumerate(node.outputs):
         out._tape_node = node
         out._tape_index = i
@@ -225,6 +229,8 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
         if create_graph and node.primal_fn is not None:
             in_cts = _recorded_node_backward(node, filled)
         else:
+            if create_graph and node.hogr_error:
+                raise NotImplementedError(node.hogr_error)
             raw = tuple(f._data if hasattr(f, "_data") else f
                         for f in filled)
             in_cts = node.vjp_fn(raw)

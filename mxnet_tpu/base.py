@@ -51,11 +51,26 @@ integer_types = (int, _np.integer)
 
 
 def dtype_np(dtype):
-    """Normalize a user-provided dtype (str/np.dtype/None) to np.dtype."""
+    """Normalize a user-provided dtype (str/np.dtype/None) to np.dtype.
+
+    64-bit dtype posture (docs/MIGRATION.md): with x64 off (the TPU-native
+    default — f64 has no MXU path), a requested int64/uint64/float64 is
+    canonicalized to its 32-bit twin HERE, deliberately and silently; jax
+    would otherwise truncate it anyway, with a warning per call site.
+    ``mx.config.enable_x64()`` (MXTPU_ENABLE_X64) restores true 64-bit,
+    matching the reference's MXNET_USE_INT64_TENSOR_SIZE build flag.
+    """
     if dtype is None:
         return _np.dtype("float32")
     if isinstance(dtype, str) and dtype == "bfloat16":
         import ml_dtypes
 
         return _np.dtype(ml_dtypes.bfloat16)
-    return _np.dtype(dtype)
+    dt = _np.dtype(dtype)
+    if dt.itemsize == 8 and dt.kind in "iuf":
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            dt = _np.dtype({"i": "int32", "u": "uint32",
+                            "f": "float32"}[dt.kind])
+    return dt

@@ -180,6 +180,15 @@ register_knob(
     "threshold for 2-bit gradient compression (kvstore."
     "set_gradient_compression), reference gradient_compression.cc:44.")
 
+# data loading
+register_knob(
+    "dataloader.start_method", "MXTPU_DATALOADER_START_METHOD", str,
+    "spawn",
+    "multiprocessing start method for DataLoader process workers: spawn "
+    "(default — safe with the multithreaded jax parent), forkserver, or "
+    "fork (opt-in: cheapest, but forking a live XLA runtime risks "
+    "deadlock; reference dataloader.py:558 is likewise spawn-capable).")
+
 # bench / testing
 register_knob(
     "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 520.0,

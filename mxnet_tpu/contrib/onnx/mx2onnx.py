@@ -318,8 +318,10 @@ def export_model(sym, params, input_shapes, input_type=_np.float32,
                 "ONNX export: no converter for op %r (supported: %s)"
                 % (n.op, sorted(_CONVERTERS)))
         from ...symbol.symbol import Symbol
-        ins = [ctx.out_name(x) if isinstance(x, Symbol) else ctx.const(x)
-               for x in n.inputs]
+        # None input slots (e.g. the bias of a no_bias FullyConnected) must
+        # not become initializers; converters skip them by arity/attrs
+        ins = [ctx.out_name(x) if isinstance(x, Symbol) else
+               (None if x is None else ctx.const(x)) for x in n.inputs]
         conv(n, ins, ctx.out_name(n), ctx)
         if verbose:
             print("converted %s -> %s" % (n.op, ctx.out_name(n)))
@@ -328,6 +330,15 @@ def export_model(sym, params, input_shapes, input_type=_np.float32,
         vo = graph.output.add()
         vo.name = ctx.out_name(h)
         vo.type.tensor_type.elem_type = onnx_dt
+
+    # drop orphan initializers no node consumes — a consumer would surface
+    # them as spurious bindable params on import
+    used = {i for node in graph.node for i in node.input}
+    used |= {o.name for o in graph.output}
+    kept = [t for t in graph.initializer if t.name in used]
+    if len(kept) != len(graph.initializer):
+        del graph.initializer[:]
+        graph.initializer.extend(kept)
 
     with open(onnx_file_path, "wb") as f:
         f.write(model.SerializeToString())

@@ -60,7 +60,18 @@ def _halve_pads(pads):
     return list(begin)
 
 
+def _check_auto_pad(at, op):
+    ap = at.get("auto_pad", "NOTSET")
+    if ap not in ("", "NOTSET", "VALID"):  # VALID == explicit zero pads
+        # SAME_UPPER/SAME_LOWER/VALID would import to wrong numerics if
+        # silently dropped (ADVICE r4): the exporter must bake explicit pads.
+        raise NotImplementedError(
+            "ONNX import: %s auto_pad=%r is not supported (re-export with "
+            "explicit pads)" % (op, ap))
+
+
 def _imp_conv(node, sym_ins, at, mx, shapes):
+    _check_auto_pad(at, "Conv")
     kernel = at["kernel_shape"]
     kw = dict(kernel=tuple(kernel),
               stride=tuple(at.get("strides", [1] * len(kernel))),
@@ -96,6 +107,11 @@ def _imp_pool(op):
             return mx.sym.Pooling(
                 sym_ins[0], kernel=(1, 1), global_pool=True,
                 pool_type="avg" if "Average" in op else "max")
+        _check_auto_pad(at, op)
+        if int(at.get("ceil_mode", 0)) != 0:
+            raise NotImplementedError(
+                "ONNX import: %s ceil_mode=1 is not supported (output "
+                "shape would differ from floor-mode pooling)" % op)
         kernel = at["kernel_shape"]
         return mx.sym.Pooling(
             sym_ins[0], kernel=tuple(kernel),
@@ -124,6 +140,10 @@ def _imp_softmax(node, sym_ins, at, mx, shapes):
 
 
 def _imp_flatten(node, sym_ins, at, mx, shapes):
+    if int(at.get("axis", 1)) != 1:
+        raise NotImplementedError(
+            "ONNX import: Flatten axis=%d (only the default axis=1 maps "
+            "to mx Flatten)" % int(at["axis"]))
     return mx.sym.Flatten(sym_ins[0])
 
 
@@ -197,6 +217,7 @@ _IMPORTERS = {
     "Div": _imp_binary("broadcast_div"),
     "Softmax": _imp_softmax,
     "Flatten": _imp_flatten,
+    "Reshape": _imp_reshape,
     "Identity": _imp_identity,
     "Dropout": _imp_identity,
     "Concat": _imp_concat,
@@ -217,22 +238,44 @@ def import_model(model_file):
         model.ParseFromString(f.read())
     g = model.graph
 
-    params = {t.name: _tensor_to_np(t) for t in g.initializer}
+    opset = max((o.version for o in model.opset_import
+                 if o.domain in ("", "ai.onnx")), default=0)
+    if 0 < opset < 13 and any(n.op_type == "Softmax" for n in g.node):
+        import warnings
+        warnings.warn(
+            "ONNX import: file declares opset %d; Softmax before opset 13 "
+            "flattened to 2D (axis default 1) — importing with opset-13 "
+            "elementwise semantics (axis default -1)" % opset, stacklevel=2)
+
+    inits = {t.name: _tensor_to_np(t) for t in g.initializer}
+    params = dict(inits)
     tensors = {}
     shapes = {name: tuple(arr.shape) for name, arr in params.items()}
     consumers = {}
+    shape_inputs, data_inputs = set(), set()
     for node in g.node:
-        for i in node.input:
+        for pos, i in enumerate(node.input):
             consumers.setdefault(i, set()).add(node.op_type)
+            if node.op_type == "Reshape" and pos == 1:
+                shape_inputs.add(i)
+            else:
+                data_inputs.add(i)
     shapes["__consumers__"] = consumers
+    # Initializers consumed only as Reshape shape operands are graph
+    # plumbing, not bindable parameters (ADVICE r4): they are folded into
+    # the Reshape attrs below and must not surface as Variables/arg_params.
+    shape_only = {n for n in shape_inputs & set(params)
+                  if n not in data_inputs}
+    for n in shape_only:
+        del params[n]
     for vi in g.input:
-        if vi.name in params:
+        if vi.name in params or vi.name in shape_only:
             continue
         tensors[vi.name] = mx.sym.Variable(vi.name)
     for name in params:
         tensors[name] = mx.sym.Variable(name)
 
-    consts = dict(params)  # shape tensors for Reshape etc.
+    consts = inits  # shape tensors for Reshape etc. (incl. shape_only)
     for node in g.node:
         imp = _IMPORTERS.get(node.op_type)
         if imp is None:

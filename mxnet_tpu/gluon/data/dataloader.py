@@ -42,6 +42,13 @@ _worker_dataset = None
 def _worker_initializer(dataset):
     global _worker_dataset
     _worker_dataset = dataset
+    try:
+        # workers are host-side: pin any jax use to CPU so a worker can
+        # never initialize the (single-client) TPU tunnel backend
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — fork children inherit a live config
+        pass
 
 
 def _worker_fn(samples, batchify_fn, dataset=None):
@@ -144,7 +151,8 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=True, timeout=120):
+                 prefetch=None, thread_pool=True, timeout=120,
+                 start_method=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
@@ -184,9 +192,15 @@ class DataLoader:
             if thread_pool:
                 self._pool = ThreadPoolExecutor(max_workers=self._num_workers)
             else:
-                ctx = multiprocessing.get_context("fork")
-                # snapshot to host BEFORE forking: children index numpy,
-                # never the jax runtime (see Dataset.host_view)
+                if start_method is None:
+                    from ... import config as _cfg
+                    start_method = _cfg.get("dataloader.start_method")
+                # spawn (default): workers start from a clean interpreter —
+                # no fork-of-a-multithreaded-XLA-runtime deadlock class.
+                # fork stays available as an opt-in for cheap startup.
+                ctx = multiprocessing.get_context(start_method)
+                # snapshot to host BEFORE handing off: children index
+                # numpy, never the jax runtime (see Dataset.host_view)
                 host_ds = dataset.host_view() if hasattr(
                     dataset, "host_view") else dataset
                 self._pool = ProcessPoolExecutor(
@@ -239,9 +253,24 @@ class _PrefetchIter:
     def __next__(self):
         if not self._pending:
             raise StopIteration
+        import concurrent.futures as _cf
         fut = self._pending.pop(0)
+        try:
+            out = fut.result(timeout=self._loader._timeout)
+        except _cf.TimeoutError:
+            # keep a still-running future owned WITHOUT submitting a
+            # replacement (retry loops must not grow the queue): its shm
+            # segments — unregistered from the worker's resource tracker —
+            # must still be unlinked by close() once it completes, or they
+            # leak in /dev/shm (ADVICE r4)
+            self._pending.insert(0, fut)
+            raise
+        except Exception:
+            # worker raised: no shm was exported; refill the pipeline so
+            # a skip-bad-batch consumer keeps its prefetch depth
+            self._push_next()
+            raise
         self._push_next()
-        out = fut.result(timeout=self._loader._timeout)
         if not self._loader._thread_pool:
             out = _shm_import(out)
         return out

@@ -180,8 +180,13 @@ class SequentialModule(BaseModule):
         return self._modules[0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
+        # every take_labels module contributes (reference dispatches to all
+        # META_TAKE_LABELS modules, module/sequential_module.py); only when
+        # none is flagged does the tail module report
+        any_taken = False
         for module, meta in zip(self._modules, self._metas):
             if meta.get(self.META_TAKE_LABELS, False):
                 module.update_metric(eval_metric, labels, pre_sliced)
-                return
-        self._modules[-1].update_metric(eval_metric, labels, pre_sliced)
+                any_taken = True
+        if not any_taken:
+            self._modules[-1].update_metric(eval_metric, labels, pre_sliced)

@@ -538,8 +538,9 @@ def array(source_array, ctx=None, dtype=None):
         # MXNet: dtype defaults to source.dtype for ndarray sources, float32
         # for python lists/scalars
         if isinstance(source_array, (_np.ndarray, jax.Array)):
-            dt = source_array.dtype
-            dtype = _np.float32 if dt == _np.float64 else dt
+            # dtype_np canonicalizes 64-bit to 32-bit when x64 is off and
+            # preserves true f64/i64 when opted in (MIGRATION.md posture)
+            dtype = source_array.dtype
         else:
             dtype = _np.float32
     val = jnp.asarray(source_array, dtype=dtype_np(dtype))

@@ -121,7 +121,12 @@ def apply_op(op, *inputs, **attrs):
             cts = _op.sparse_vjp(_in, _attrs, cotangents)
             return tuple(cts[i] for i in _nd_pos)
 
-        _tape.record_node(nd_inputs, outs, sparse_vjp_fn, name=op.name)
+        _tape.record_node(
+            nd_inputs, outs, sparse_vjp_fn, name=op.name,
+            hogr_error="%s with sparse_grad=True produces a row-sparse "
+                       "cotangent that cannot be re-taped; use "
+                       "sparse_grad=False for create_graph=True "
+                       "higher-order gradients" % op.name)
         return outs if multi else outs[0]
 
     if recording:

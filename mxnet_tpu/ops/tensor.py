@@ -336,19 +336,28 @@ def _full_like(a, fill_value=0.0, **_):
 
 @register("shape_array", differentiable=False)
 def _shape_array(a, **_):
-    return jnp.asarray(a.shape, dtype=jnp.int64 if False else jnp.int32)
+    return _as_index(a.shape)
 
 
 @register("size_array", differentiable=False)
 def _size_array(a, **_):
-    return jnp.asarray([a.size], dtype=jnp.int32)
+    return _as_index([a.size])
 
 
 # ---------------------------------------------------------------- indexing
 
+def _as_index(x):
+    """Canonical index dtype: int32 by default (covers every single-core
+    array), int64 when x64 is opted in so >2^31 offsets survive
+    (docs/MIGRATION.md int64 posture)."""
+    import jax as _jax
+    dt = jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(x).astype(dt)
+
+
 @register("take")
 def _take(a, indices, axis=0, mode="clip", **_):
-    idx = jnp.asarray(indices).astype(jnp.int32)
+    idx = _as_index(indices)
     if mode == "wrap":
         idx = jnp.mod(idx, a.shape[axis])
     else:
@@ -358,7 +367,7 @@ def _take(a, indices, axis=0, mode="clip", **_):
 
 @register("Embedding", aliases=("embedding",))
 def _embedding(data, weight, input_dim=None, output_dim=None, **_):
-    idx = jnp.asarray(data).astype(jnp.int32)
+    idx = _as_index(data)
     return jnp.take(weight, idx, axis=0)
 
 
@@ -371,7 +380,7 @@ def _embedding_sparse_vjp(in_arrays, attrs, cotangents):
     from ..ndarray.sparse import RowSparseTangent
     data, weight = in_arrays[0], in_arrays[1]
     (ct,) = cotangents if len(cotangents) == 1 else (cotangents[0],)
-    ids = jnp.asarray(data).astype(jnp.int32).ravel()
+    ids = _as_index(data).ravel()
     vals = jnp.reshape(ct, (ids.shape[0], -1))
     return (None, RowSparseTangent(ids, vals, weight.shape))
 
@@ -383,13 +392,13 @@ _get_op("Embedding").sparse_vjp = _embedding_sparse_vjp
 @register("one_hot", differentiable=False)
 def _one_hot(a, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **_):
     from ..base import dtype_np
-    oh = jax.nn.one_hot(jnp.asarray(a).astype(jnp.int32), depth)
+    oh = jax.nn.one_hot(_as_index(a), depth)
     return (oh * (on_value - off_value) + off_value).astype(dtype_np(dtype))
 
 
 @register("pick")
 def _pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
-    idx = jnp.clip(jnp.asarray(index).astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx = jnp.clip(_as_index(index), 0, data.shape[axis] - 1)
     out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis)
@@ -398,21 +407,21 @@ def _pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
 
 @register("gather_nd")
 def _gather_nd(data, indices, **_):
-    idx = jnp.asarray(indices).astype(jnp.int32)
+    idx = _as_index(indices)
     # indices shape (M, ...) indexes the first M dims of data
     return data[tuple(idx[i] for i in range(idx.shape[0]))]
 
 
 @register("scatter_nd")
 def _scatter_nd(data, indices, shape=None, **_):
-    idx = jnp.asarray(indices).astype(jnp.int32)
+    idx = _as_index(indices)
     out = jnp.zeros(shape, data.dtype)
     return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(data)
 
 
 @register("take_along_axis")
 def _take_along_axis(a, indices, axis=0, **_):
-    return jnp.take_along_axis(a, jnp.asarray(indices).astype(jnp.int32), axis)
+    return jnp.take_along_axis(a, _as_index(indices), axis)
 
 
 @register("boolean_mask", differentiable=False)
@@ -616,7 +625,7 @@ def _space_to_depth(data, block_size=1, **_):
 def _batch_take(a, indices, **_):
     """out[i] = a[i, indices[i]] (reference indexing_op.cc batch_take)."""
     x = jnp.asarray(a)
-    idx = jnp.asarray(indices).astype(jnp.int32)
+    idx = _as_index(indices)
     return jnp.take_along_axis(x, idx.reshape(-1, 1), axis=1)[:, 0]
 
 
@@ -658,20 +667,20 @@ def _argmax_channel(data, **_):
 
 @register("unravel_index", differentiable=False)
 def _unravel_index(data, shape=None, **_):
-    idx = jnp.asarray(data).astype(jnp.int32)
+    idx = _as_index(data)
     coords = jnp.unravel_index(idx, tuple(shape))
     return jnp.stack(coords, axis=0)
 
 
 @register("ravel_multi_index", differentiable=False)
 def _ravel_multi_index(data, shape=None, **_):
-    coords = jnp.asarray(data).astype(jnp.int32)
+    coords = _as_index(data)
     mult = []
     acc = 1
     for s in reversed(tuple(shape)):
         mult.append(acc)
         acc *= s
-    mult = jnp.asarray(list(reversed(mult)), jnp.int32)
+    mult = _as_index(list(reversed(mult)))
     return jnp.sum(coords * mult.reshape(-1, *([1] * (coords.ndim - 1))),
                    axis=0).astype(jnp.float32)
 

@@ -232,3 +232,31 @@ def test_python_loss_module():
         seq.backward()
         seq.update()
     assert last < first / 20, (first, last)
+
+
+def test_sequential_module_metric_dispatch_all_take_labels():
+    """ADVICE r4: update_metric must reach EVERY take_labels module (the
+    reference dispatches to all META_TAKE_LABELS modules), and fall back
+    to the tail module only when none is flagged."""
+    from mxnet_tpu.module import SequentialModule
+
+    calls = []
+
+    class _Stub:
+        def __init__(self, name):
+            self.name = name
+
+        def update_metric(self, eval_metric, labels, pre_sliced=False):
+            calls.append(self.name)
+
+    seq = SequentialModule()
+    seq._modules = [_Stub("a"), _Stub("b"), _Stub("c")]
+    seq._metas = [{seq.META_TAKE_LABELS: True}, {},
+                  {seq.META_TAKE_LABELS: True}]
+    seq.update_metric(None, None)
+    assert calls == ["a", "c"]
+
+    calls.clear()
+    seq._metas = [{}, {}, {}]
+    seq.update_metric(None, None)
+    assert calls == ["c"]

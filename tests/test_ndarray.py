@@ -173,3 +173,50 @@ def test_mutation_guard_under_record():
             a += 1
         with pytest.raises(RuntimeError):
             b[:] = 0
+
+
+def test_int64_request_is_silent_int32_by_default():
+    """docs/MIGRATION.md int64 posture: with x64 off, a requested 64-bit
+    dtype canonicalizes to its 32-bit twin with NO truncation warning
+    (the reference keeps int32 indexing unless built with
+    MXNET_USE_INT64_TENSOR_SIZE)."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any UserWarning fails the test
+        a = mx.nd.array(np.arange(4, dtype=np.int64))
+        assert a.dtype == np.int32
+        b = mx.nd.array([1.0, 2.0], dtype="float64")
+        assert b.dtype == np.float32
+        c = mx.nd.cast(a, dtype="int64")
+        assert c.dtype == np.int32
+        z = mx.nd.zeros((2,), dtype="int64")
+        assert z.dtype == np.int32
+
+
+def test_large_index_int64():
+    """Large-tensor suite analog (reference tests/nightly/
+    test_large_array.py:1), scaled to host memory: with x64 opted in, a
+    >2^31-element array indexes correctly past the int32 boundary."""
+    import mxnet_tpu.config as cfg
+    avail_kb = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemAvailable"):
+                avail_kb = int(line.split()[1])
+    if avail_kb < 8 * 1024 * 1024:
+        pytest.skip("needs ~6 GiB free host memory (2 GiB array + "
+                    "functional-update copies)")
+    cfg.set("numpy.enable_x64", True)
+    try:
+        n = 2 ** 31 + 16
+        a = mx.nd.zeros((n,), dtype="int8")
+        assert a.size == n
+        idx = 2 ** 31 + 5
+        a[idx] = 7
+        inds = mx.nd.array(np.array([idx, 3], dtype=np.int64),
+                           dtype="int64")
+        assert inds.dtype == np.int64
+        out = mx.nd.take(a, inds).asnumpy()
+        assert out.tolist() == [7, 0]
+    finally:
+        cfg.set("numpy.enable_x64", False)

@@ -84,3 +84,74 @@ def test_onnx_export_unsupported_op_is_loud(tmp_path):
         mx.contrib.onnx.export_model(s, {}, [(2, 2)],
                                      onnx_file_path=str(tmp_path / "x.onnx"))
 
+
+def _export_conv_model(tmp_path, name):
+    """A tiny Conv+Pool+Flatten graph exported to ONNX, returned parsed."""
+    from mxnet_tpu.contrib.onnx import onnx_minimal_pb2 as O
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1, in_channels=3),
+            gluon.nn.MaxPool2D(2), gluon.nn.Flatten(),
+            gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = np.zeros((1, 3, 8, 8), np.float32)
+    net(mx.nd.array(x))
+    prefix = str(tmp_path / name)
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    params = mx.nd.load(prefix + "-0000.params")
+    onnx_path = str(tmp_path / (name + ".onnx"))
+    mx.contrib.onnx.export_model(sym, params, [(1, 3, 8, 8)],
+                                 onnx_file_path=onnx_path)
+    m = O.ModelProto()
+    m.ParseFromString(open(onnx_path, "rb").read())
+    return m, onnx_path
+
+
+def _mutate_and_import(model, onnx_path, op_type, attr_name, attr_val):
+    """Add an int/string attribute to the first op_type node, reimport."""
+    node = next(n for n in model.graph.node if n.op_type == op_type)
+    a = node.attribute.add()
+    a.name = attr_name
+    if isinstance(attr_val, bytes):
+        a.type, a.s = 3, attr_val
+    else:
+        a.type, a.i = 2, attr_val
+    with open(onnx_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return mx.contrib.onnx.import_model(onnx_path)
+
+
+@pytest.mark.parametrize("op_type,attr,val", [
+    ("Conv", "auto_pad", b"SAME_UPPER"),
+    ("MaxPool", "auto_pad", b"SAME_UPPER"),
+    ("MaxPool", "ceil_mode", 1),
+    ("Flatten", "axis", 2),
+])
+def test_onnx_import_unsupported_attr_is_loud(tmp_path, op_type, attr, val):
+    """Attributes the importer does not model must raise, not silently
+    import to wrong numerics (ADVICE r4: auto_pad / ceil_mode / Flatten
+    axis)."""
+    m, path = _export_conv_model(tmp_path, "attr")
+    with pytest.raises(NotImplementedError, match=attr):
+        _mutate_and_import(m, path, op_type, attr, val)
+
+
+def test_onnx_import_reshape_shape_not_a_param(tmp_path):
+    """Reshape shape initializers are graph plumbing: they must not
+    surface as bindable arg_params (ADVICE r4)."""
+    v = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    s = mx.sym.FullyConnected(mx.sym.Reshape(v, shape=(2, 6)), w,
+                              num_hidden=3, no_bias=True, flatten=False)
+    params = {"w": mx.nd.array(np.ones((3, 6), np.float32))}
+    onnx_path = str(tmp_path / "rshp.onnx")
+    mx.contrib.onnx.export_model(s, params, [(3, 4)],
+                                 onnx_file_path=onnx_path)
+    sym2, arg, aux = mx.contrib.onnx.import_model(onnx_path)
+    assert not [k for k in arg if k.startswith("const_")], arg.keys()
+    assert not aux
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    ex = sym2.bind(args={"data": mx.nd.array(x), **arg}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, x.reshape(2, 6) @ np.ones((6, 3)))
+

@@ -293,3 +293,21 @@ def test_kvstore_row_sparse_pull_sparse_out():
     assert out._dense_cache is None
     np.testing.assert_array_equal(np.asarray(out._indices), [17, 4999])
     np.testing.assert_allclose(np.asarray(out._values), big[[17, 4999]])
+
+
+def test_sparse_grad_create_graph_raises():
+    """ADVICE r4: the row-sparse cotangent path records no primal_fn, so
+    create_graph=True through Embedding(sparse_grad=True) must raise
+    loudly instead of silently returning zero higher-order grads."""
+    import pytest
+    from mxnet_tpu import autograd
+    w = mx.nd.array(np.random.RandomState(0).normal(
+        size=(6, 3)).astype(np.float32))
+    w.attach_grad()
+    ids = mx.nd.array(np.array([1, 4], np.float32))
+    with autograd.record():
+        out = mx.nd.Embedding(ids, w, input_dim=6, output_dim=3,
+                              sparse_grad=True)
+        loss = (out ** 2).sum()
+        with pytest.raises(NotImplementedError, match="sparse_grad"):
+            autograd.grad(loss, w, create_graph=True, retain_graph=True)
