@@ -141,11 +141,18 @@ class BaseModule:
         eval_metric.reset()
         if reset:
             eval_data.reset()
+        nbatch = 0
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
+        if score_end_callback is not None:
+            from ..callback import BatchEndParam
+            params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                   eval_metric=eval_metric, locals=locals())
+            for cb in _as_list(score_end_callback):
+                cb(params)
         return eval_metric.get_name_value()
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,

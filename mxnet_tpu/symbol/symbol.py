@@ -398,6 +398,12 @@ _OP_INPUT_SLOTS = {
     "GroupNorm": ("data", "gamma", "beta"),
     "InstanceNorm": ("data", "gamma", "beta"),
     "Embedding": ("data", "weight"),
+    # output-loss ops auto-create their label input as "{name}_label"
+    # (reference: mx.symbol.SoftmaxOutput(fc, name='sm') binds 'sm_label')
+    "SoftmaxOutput": ("data", "label"),
+    "LinearRegressionOutput": ("data", "label"),
+    "LogisticRegressionOutput": ("data", "label"),
+    "MAERegressionOutput": ("data", "label"),
 }
 
 
@@ -492,7 +498,21 @@ _INT_DATA_OPS = {"Embedding", "one_hot", "take"}
 _SHAPE_TRANSPARENT = {"cast", "_sim_quant", "identity", "BlockGrad",
                       "Dropout", "make_loss", "negative", "relu", "abs"}
 
+def _softmax_output_label_shape(attrs, dshape):
+    # reference SoftmaxOutput FInferShape: label is (N,) class indices
+    return {1: (dshape[0],)}
+
+
+def _regression_output_label_shape(attrs, dshape):
+    # *RegressionOutput: label matches the prediction shape
+    return {1: tuple(dshape)}
+
+
 _PARAM_SHAPE_RULES = {
+    "SoftmaxOutput": _softmax_output_label_shape,
+    "LinearRegressionOutput": _regression_output_label_shape,
+    "LogisticRegressionOutput": _regression_output_label_shape,
+    "MAERegressionOutput": _regression_output_label_shape,
     "FullyConnected": _fc_param_shapes,
     "Convolution": _conv_param_shapes,
     "_contrib_quantized_fully_connected": _fc_param_shapes,

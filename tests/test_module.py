@@ -260,3 +260,18 @@ def test_sequential_module_metric_dispatch_all_take_labels():
     seq._metas = [{}, {}, {}]
     seq.update_metric(None, None)
     assert calls == ["c"]
+
+
+def test_module_bind_without_label_shapes():
+    """Deploy flow parity: bind(for_training=False) with NO label_shapes
+    must infer the auto-created softmax_label's shape from the data
+    (reference SoftmaxOutput FInferShape)."""
+    fc = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3)
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (5, 7))], for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch([mx.nd.ones((5, 7))], None), is_train=False)
+    assert mod.get_outputs()[0].shape == (5, 3)
