@@ -1,0 +1,185 @@
+/* C host demo for the mxnet_tpu core C ABI (src/native/c_api.cc) — the
+ * analog of a host app using the reference's include/mxnet/c_api.h
+ * NDArray + imperative-invoke + symbol surface.
+ *
+ * Exercises: NDArray create-from-bytes, imperative invoke (broadcast_add
+ * and an attr-carrying FullyConnected), save/load roundtrip, symbol JSON
+ * roundtrip, WaitAll.  Prints C_API_OK on success.
+ *
+ * Usage: demo <libpath> <workdir>
+ *   gcc demo.c -o demo -ldl
+ *   MXTPU_C_PLATFORM=cpu PYTHONPATH=/path/to/repo ./demo lib.so /tmp
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef const char *(*err_fn)(void);
+typedef int (*create_fn)(const long *, int, int, void **);
+typedef int (*frombytes_fn)(const void *, long, const long *, int, int,
+                            void **);
+typedef int (*free_fn)(void *);
+typedef int (*shape_fn)(void *, long *, int, int *);
+typedef int (*dtype_fn)(void *, int *);
+typedef int (*data_fn)(void *, void *, long, long *);
+typedef int (*save_fn)(const char *, int, void **, const char **);
+typedef int (*loadc_fn)(const char *, void **, int *);
+typedef int (*loadg_fn)(void *, int, void **, const char **);
+typedef int (*loadf_fn)(void *);
+typedef int (*invoke_fn)(const char *, int, void **, int, const char **,
+                         const char **, int, void **, int *);
+typedef int (*symjson_fn)(const char *, void **);
+typedef int (*symto_fn)(void *, char *, long, long *);
+typedef int (*waitall_fn)(void);
+
+#define CHECK(cond, msg)                                        \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL %s: %s\n", msg,                     \
+              lasterr ? lasterr() : "?");                       \
+      return 1;                                                 \
+    }                                                           \
+  } while (0)
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <libpath> <workdir>\n", argv[0]);
+    return 2;
+  }
+  void *lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  err_fn lasterr = (err_fn)dlsym(lib, "MXTpuCGetLastError");
+  create_fn nd_create = (create_fn)dlsym(lib, "MXTpuNDArrayCreate");
+  frombytes_fn nd_frombytes =
+      (frombytes_fn)dlsym(lib, "MXTpuNDArrayCreateFromBytes");
+  free_fn nd_free = (free_fn)dlsym(lib, "MXTpuNDArrayFree");
+  shape_fn nd_shape = (shape_fn)dlsym(lib, "MXTpuNDArrayGetShape");
+  dtype_fn nd_dtype = (dtype_fn)dlsym(lib, "MXTpuNDArrayGetDType");
+  data_fn nd_data = (data_fn)dlsym(lib, "MXTpuNDArrayGetData");
+  save_fn nd_save = (save_fn)dlsym(lib, "MXTpuNDArraySave");
+  loadc_fn nd_loadc = (loadc_fn)dlsym(lib, "MXTpuNDArrayLoadCreate");
+  loadg_fn nd_loadg = (loadg_fn)dlsym(lib, "MXTpuNDArrayLoadGet");
+  loadf_fn nd_loadf = (loadf_fn)dlsym(lib, "MXTpuNDArrayLoadFree");
+  invoke_fn invoke = (invoke_fn)dlsym(lib, "MXTpuImperativeInvoke");
+  symjson_fn sym_from = (symjson_fn)dlsym(lib, "MXTpuSymbolCreateFromJSON");
+  symto_fn sym_to = (symto_fn)dlsym(lib, "MXTpuSymbolToJSON");
+  symto_fn sym_args = (symto_fn)dlsym(lib, "MXTpuSymbolListArguments");
+  free_fn sym_free = (free_fn)dlsym(lib, "MXTpuSymbolFree");
+  waitall_fn waitall = (waitall_fn)dlsym(lib, "MXTpuWaitAll");
+  if (!lasterr || !nd_create || !nd_frombytes || !nd_free || !nd_shape ||
+      !nd_dtype || !nd_data || !nd_save || !nd_loadc || !nd_loadg ||
+      !nd_loadf || !invoke || !sym_from || !sym_to || !sym_args ||
+      !sym_free || !waitall) {
+    fprintf(stderr, "missing symbols\n");
+    return 2;
+  }
+
+  /* ---- NDArray create + elementwise invoke ---- */
+  float abuf[6] = {1, 2, 3, 4, 5, 6};
+  float bbuf[6] = {10, 20, 30, 40, 50, 60};
+  long shp[2] = {2, 3};
+  void *a = NULL, *b = NULL;
+  CHECK(nd_frombytes(abuf, sizeof(abuf), shp, 2, 0, &a) == 0, "frombytes a");
+  CHECK(nd_frombytes(bbuf, sizeof(bbuf), shp, 2, 0, &b) == 0, "frombytes b");
+
+  void *ins[2] = {a, b};
+  void *outs[4];
+  int num_out = 0;
+  CHECK(invoke("broadcast_add", 2, ins, 0, NULL, NULL, 4, outs,
+               &num_out) == 0 && num_out == 1, "invoke add");
+  float sum[6];
+  long nbytes = 0;
+  CHECK(nd_data(outs[0], sum, sizeof(sum), &nbytes) == 0 &&
+        nbytes == sizeof(sum), "get add data");
+  for (int i = 0; i < 6; ++i) {
+    if (sum[i] != abuf[i] + bbuf[i]) {
+      fprintf(stderr, "add value mismatch at %d: %f\n", i, sum[i]);
+      return 1;
+    }
+  }
+  printf("add ok: %.1f %.1f\n", sum[0], sum[5]);
+
+  /* ---- attr-carrying invoke: FullyConnected(no_bias, num_hidden=4) ---- */
+  float wbuf[12];
+  for (int i = 0; i < 12; ++i) wbuf[i] = 0.5f * (float)(i % 3);
+  long wshp[2] = {4, 3};
+  void *w = NULL;
+  CHECK(nd_frombytes(wbuf, sizeof(wbuf), wshp, 2, 0, &w) == 0, "weight");
+  const char *keys[2] = {"num_hidden", "no_bias"};
+  const char *vals[2] = {"4", "True"};
+  void *fc_ins[2] = {a, w};
+  CHECK(invoke("FullyConnected", 2, fc_ins, 2, keys, vals, 4, outs + 1,
+               &num_out) == 0 && num_out == 1, "invoke fc");
+  long fcdims[4];
+  int fcnd = 0;
+  CHECK(nd_shape(outs[1], fcdims, 4, &fcnd) == 0 && fcnd == 2 &&
+        fcdims[0] == 2 && fcdims[1] == 4, "fc shape");
+  printf("fc shape: %ld %ld\n", fcdims[0], fcdims[1]);
+
+  /* ---- save / load roundtrip ---- */
+  char path[1024];
+  snprintf(path, sizeof(path), "%s/c_api_demo.params", argv[2]);
+  void *saved[2] = {a, outs[0]};
+  const char *names[2] = {"a", "sum"};
+  CHECK(nd_save(path, 2, saved, names) == 0, "save");
+  void *bundle = NULL;
+  int count = 0;
+  CHECK(nd_loadc(path, &bundle, &count) == 0 && count == 2, "load");
+  int found = 0;
+  for (int i = 0; i < count; ++i) {
+    void *nd = NULL;
+    const char *nm = NULL;
+    CHECK(nd_loadg(bundle, i, &nd, &nm) == 0, "load get");
+    if (strcmp(nm, "sum") == 0) {
+      float back[6];
+      CHECK(nd_data(nd, back, sizeof(back), &nbytes) == 0, "load data");
+      if (memcmp(back, sum, sizeof(sum)) == 0) found = 1;
+    }
+    int code = -1;
+    CHECK(nd_dtype(nd, &code) == 0 && code == 0, "load dtype");
+    nd_free(nd);
+  }
+  CHECK(found, "load roundtrip value check");
+  nd_loadf(bundle);
+  printf("save/load ok: %d arrays\n", count);
+
+  /* ---- symbol JSON roundtrip (argv[3] = a -symbol.json file) ---- */
+  if (argc > 3) {
+    FILE *f = fopen(argv[3], "rb");
+    CHECK(f != NULL, "open symbol json");
+    fseek(f, 0, SEEK_END);
+    long flen = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char *json = (char *)malloc((size_t)flen + 1);
+    CHECK(fread(json, 1, (size_t)flen, f) == (size_t)flen, "read json");
+    json[flen] = 0;
+    fclose(f);
+    void *sym = NULL;
+    CHECK(sym_from(json, &sym) == 0, "sym from json");
+    char argsbuf[4096];
+    long need = 0;
+    CHECK(sym_args(sym, argsbuf, sizeof(argsbuf), &need) == 0, "sym args");
+    printf("sym args: [%s]\n", argsbuf);
+    char *jbuf = (char *)malloc(1 << 20);
+    CHECK(sym_to(sym, jbuf, 1 << 20, &need) == 0 && need > 2, "sym json");
+    void *sym2 = NULL;
+    CHECK(sym_from(jbuf, &sym2) == 0, "sym reparse");
+    sym_free(sym2);
+    free(jbuf);
+    free(json);
+    sym_free(sym);
+  }
+
+  CHECK(waitall() == 0, "waitall");
+  nd_free(a);
+  nd_free(b);
+  nd_free(w);
+  nd_free(outs[0]);
+  nd_free(outs[1]);
+  printf("C_API_OK\n");
+  return 0;
+}

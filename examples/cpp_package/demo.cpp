@@ -1,0 +1,65 @@
+// C++ host demo for the mxtpu header-only bindings (include/mxtpu/cpp.hpp)
+// — the analog of the reference's cpp-package examples
+// (cpp-package/example/*.cpp over mxnet-cpp).
+//
+// Usage: demo <libmxtpu_c_api.so> <workdir> [symbol.json]
+// Build: g++ -std=c++17 -I include demo.cpp -o demo -ldl
+#include <mxtpu/cpp.hpp>
+
+#include <cstdio>
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <libpath> <workdir> [symbol.json]\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    auto lib = mxtpu::Lib::Load(argv[1]);
+
+    mxtpu::NDArray a(lib, {1, 2, 3, 4, 5, 6}, {2, 3});
+    mxtpu::NDArray b(lib, {10, 20, 30, 40, 50, 60}, {2, 3});
+    auto sum = mxtpu::Op(lib, "broadcast_add").Invoke({&a, &b});
+    auto host = sum[0].CopyTo();
+    std::printf("add: %.1f %.1f\n", host.front(), host.back());
+    if (host.front() != 11.f || host.back() != 66.f) return 1;
+
+    auto sm = mxtpu::Op(lib, "softmax").SetAttr("axis", "1").Invoke({&a});
+    auto shape = sm[0].Shape();
+    std::printf("softmax shape: %ld %ld\n", shape[0], shape[1]);
+    if (shape != std::vector<long>({2, 3})) return 1;
+
+    std::string path = std::string(argv[2]) + "/cpp_demo.params";
+    mxtpu::NDArray::Save(lib, path, {{"a", &a}, {"sum", &sum[0]}});
+    auto loaded = mxtpu::NDArray::Load(lib, path);
+    std::printf("loaded %zu arrays\n", loaded.size());
+    for (auto &kv : loaded) {
+      if (kv.first == "sum" && kv.second.CopyTo() != host) return 1;
+    }
+
+    if (argc > 3) {
+      std::FILE *f = std::fopen(argv[3], "rb");
+      if (f == nullptr) return 1;
+      std::string json;
+      char buf[4096];
+      size_t n;
+      while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) {
+        json.append(buf, n);
+      }
+      std::fclose(f);
+      auto sym = mxtpu::Symbol::FromJSON(lib, json);
+      std::printf("sym args:");
+      for (const auto &s : sym.ListArguments()) std::printf(" %s", s.c_str());
+      std::printf("\n");
+      auto sym2 = mxtpu::Symbol::FromJSON(lib, sym.ToJSON());
+      if (sym2.ListOutputs().empty()) return 1;
+    }
+
+    mxtpu::WaitAll(lib);
+    std::printf("CPP_PACKAGE_OK\n");
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "exception: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,376 @@
+// mxtpu C++ bindings — header-only RAII wrapper over the core C ABI.
+//
+// Reference analog: cpp-package/include/mxnet-cpp (the header-only C++
+// binding over include/mxnet/c_api.h, SURVEY §1 row 11).  Same idea here:
+// no library to build — everything inline over the flat C surface of
+// libmxtpu_c_api.so (src/native/c_api.cc), loaded at runtime with dlopen
+// so a host app needs no link-time dependency at all.
+//
+// Usage:
+//   #include <mxtpu/cpp.hpp>
+//   auto lib = mxtpu::Lib::Load("/path/to/libmxtpu_c_api.so");
+//   mxtpu::NDArray a(lib, {1, 2, 3, 4, 5, 6}, {2, 3});
+//   mxtpu::NDArray b(lib, {10, 20, 30, 40, 50, 60}, {2, 3});
+//   auto c = mxtpu::Op(lib, "broadcast_add").Invoke({a, b})[0];
+//   std::vector<float> host = c.CopyTo();     // {11, 22, ...}
+//
+// Thread-safety: the C layer serializes on the embedded interpreter's
+// GIL; these wrappers add no state beyond the handles they own.
+
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &msg) : std::runtime_error(msg) {}
+};
+
+// Resolved entry points of one loaded libmxtpu_c_api.so.
+class Lib {
+ public:
+  using err_fn = const char *(*)();
+  using create_fn = int (*)(const long *, int, int, void **);
+  using frombytes_fn = int (*)(const void *, long, const long *, int, int,
+                               void **);
+  using free_fn = int (*)(void *);
+  using shape_fn = int (*)(void *, long *, int, int *);
+  using dtype_fn = int (*)(void *, int *);
+  using data_fn = int (*)(void *, void *, long, long *);
+  using save_fn = int (*)(const char *, int, void **, const char **);
+  using loadc_fn = int (*)(const char *, void **, int *);
+  using loadg_fn = int (*)(void *, int, void **, const char **);
+  using invoke_fn = int (*)(const char *, int, void **, int, const char **,
+                            const char **, int, void **, int *);
+  using symjson_fn = int (*)(const char *, void **);
+  using symto_fn = int (*)(void *, char *, long, long *);
+  using waitall_fn = int (*)();
+
+  static std::shared_ptr<Lib> Load(const std::string &path) {
+    auto lib = std::shared_ptr<Lib>(new Lib());
+    lib->handle_ = dlopen(path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+    if (lib->handle_ == nullptr) {
+      throw Error(std::string("dlopen failed: ") + dlerror());
+    }
+    lib->Resolve();
+    return lib;
+  }
+
+  ~Lib() {
+    // the embedded interpreter cannot be re-initialized after dlclose;
+    // keep the library resident for process lifetime (reference bindings
+    // behave the same way — libmxnet stays loaded)
+  }
+
+  void Check(int rc) const {
+    if (rc != 0) throw Error(last_error());
+  }
+
+  std::string last_error() const {
+    const char *e = get_last_error_();
+    return e == nullptr ? "unknown mxtpu error" : e;
+  }
+
+  err_fn get_last_error_ = nullptr;
+  create_fn nd_create_ = nullptr;
+  frombytes_fn nd_from_bytes_ = nullptr;
+  free_fn nd_free_ = nullptr;
+  shape_fn nd_shape_ = nullptr;
+  dtype_fn nd_dtype_ = nullptr;
+  data_fn nd_data_ = nullptr;
+  save_fn nd_save_ = nullptr;
+  loadc_fn nd_load_create_ = nullptr;
+  loadg_fn nd_load_get_ = nullptr;
+  free_fn nd_load_free_ = nullptr;
+  invoke_fn invoke_ = nullptr;
+  symjson_fn sym_from_json_ = nullptr;
+  symto_fn sym_to_json_ = nullptr;
+  symto_fn sym_list_arguments_ = nullptr;
+  symto_fn sym_list_outputs_ = nullptr;
+  free_fn sym_free_ = nullptr;
+  waitall_fn wait_all_ = nullptr;
+
+ private:
+  Lib() = default;
+
+  template <typename F>
+  void Sym(F *slot, const char *name) {
+    *slot = reinterpret_cast<F>(dlsym(handle_, name));
+    if (*slot == nullptr) {
+      throw Error(std::string("missing symbol ") + name);
+    }
+  }
+
+  void Resolve() {
+    Sym(&get_last_error_, "MXTpuCGetLastError");
+    Sym(&nd_create_, "MXTpuNDArrayCreate");
+    Sym(&nd_from_bytes_, "MXTpuNDArrayCreateFromBytes");
+    Sym(&nd_free_, "MXTpuNDArrayFree");
+    Sym(&nd_shape_, "MXTpuNDArrayGetShape");
+    Sym(&nd_dtype_, "MXTpuNDArrayGetDType");
+    Sym(&nd_data_, "MXTpuNDArrayGetData");
+    Sym(&nd_save_, "MXTpuNDArraySave");
+    Sym(&nd_load_create_, "MXTpuNDArrayLoadCreate");
+    Sym(&nd_load_get_, "MXTpuNDArrayLoadGet");
+    Sym(&nd_load_free_, "MXTpuNDArrayLoadFree");
+    Sym(&invoke_, "MXTpuImperativeInvoke");
+    Sym(&sym_from_json_, "MXTpuSymbolCreateFromJSON");
+    Sym(&sym_to_json_, "MXTpuSymbolToJSON");
+    Sym(&sym_list_arguments_, "MXTpuSymbolListArguments");
+    Sym(&sym_list_outputs_, "MXTpuSymbolListOutputs");
+    Sym(&sym_free_, "MXTpuSymbolFree");
+    Sym(&wait_all_, "MXTpuWaitAll");
+  }
+
+  void *handle_ = nullptr;
+};
+
+using LibPtr = std::shared_ptr<Lib>;
+
+// dtype codes follow the reference's mshadow codes (mxnet_tpu/base.py).
+enum class DType : int {
+  kFloat32 = 0,
+  kFloat64 = 1,
+  kFloat16 = 2,
+  kUint8 = 3,
+  kInt32 = 4,
+  kInt8 = 5,
+  kInt64 = 6,
+  kBfloat16 = 12,
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  // Zero-initialized (reference mxnet-cpp NDArray(shape, ctx)).
+  NDArray(LibPtr lib, const std::vector<long> &shape,
+          DType dtype = DType::kFloat32)
+      : lib_(std::move(lib)) {
+    lib_->Check(lib_->nd_create_(shape.data(),
+                                 static_cast<int>(shape.size()),
+                                 static_cast<int>(dtype), &handle_));
+  }
+
+  // From host float data (reference SyncCopyFromCPU folded into create).
+  NDArray(LibPtr lib, const std::vector<float> &data,
+          const std::vector<long> &shape)
+      : lib_(std::move(lib)) {
+    lib_->Check(lib_->nd_from_bytes_(
+        data.data(), static_cast<long>(data.size() * sizeof(float)),
+        shape.data(), static_cast<int>(shape.size()),
+        static_cast<int>(DType::kFloat32), &handle_));
+  }
+
+  // Adopt a raw handle (ownership transfers).
+  NDArray(LibPtr lib, void *handle)
+      : lib_(std::move(lib)), handle_(handle) {}
+
+  NDArray(NDArray &&o) noexcept : lib_(std::move(o.lib_)),
+                                  handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) {
+      Reset();
+      lib_ = std::move(o.lib_);
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  ~NDArray() { Reset(); }
+
+  std::vector<long> Shape() const {
+    long dims[16];
+    int nd = 0;
+    lib_->Check(lib_->nd_shape_(handle_, dims, 16, &nd));
+    return std::vector<long>(dims, dims + nd);
+  }
+
+  DType GetDType() const {
+    int code = 0;
+    lib_->Check(lib_->nd_dtype_(handle_, &code));
+    return static_cast<DType>(code);
+  }
+
+  long Size() const {
+    long n = 1;
+    for (long d : Shape()) n *= d;
+    return n;
+  }
+
+  // Synchronous copy to host (float32 arrays).
+  std::vector<float> CopyTo() const {
+    long nbytes = 0;
+    lib_->Check(lib_->nd_data_(handle_, nullptr, 0, &nbytes));
+    std::vector<float> out(static_cast<size_t>(nbytes) / sizeof(float));
+    lib_->Check(lib_->nd_data_(handle_, out.data(), nbytes, &nbytes));
+    return out;
+  }
+
+  void *handle() const { return handle_; }
+  const LibPtr &lib() const { return lib_; }
+
+  // Save named arrays to the reference single-file format.
+  static void Save(const LibPtr &lib, const std::string &fname,
+                   const std::vector<std::pair<std::string, NDArray *>> &items) {
+    std::vector<void *> handles;
+    std::vector<const char *> names;
+    for (const auto &kv : items) {
+      names.push_back(kv.first.c_str());
+      handles.push_back(kv.second->handle());
+    }
+    lib->Check(lib->nd_save_(fname.c_str(),
+                             static_cast<int>(items.size()),
+                             handles.data(), names.data()));
+  }
+
+  static std::vector<std::pair<std::string, NDArray>> Load(
+      const LibPtr &lib, const std::string &fname) {
+    void *bundle = nullptr;
+    int count = 0;
+    lib->Check(lib->nd_load_create_(fname.c_str(), &bundle, &count));
+    std::vector<std::pair<std::string, NDArray>> out;
+    for (int i = 0; i < count; ++i) {
+      void *nd = nullptr;
+      const char *name = nullptr;
+      lib->Check(lib->nd_load_get_(bundle, i, &nd, &name));
+      out.emplace_back(name == nullptr ? "" : name, NDArray(lib, nd));
+    }
+    lib->nd_load_free_(bundle);
+    return out;
+  }
+
+ private:
+  void Reset() {
+    if (handle_ != nullptr && lib_ != nullptr) {
+      lib_->nd_free_(handle_);
+      handle_ = nullptr;
+    }
+  }
+
+  LibPtr lib_;
+  void *handle_ = nullptr;
+};
+
+// Imperative operator invocation (reference mxnet-cpp Operator chaining).
+class Op {
+ public:
+  Op(LibPtr lib, std::string name)
+      : lib_(std::move(lib)), name_(std::move(name)) {}
+
+  // Attrs are strings; numbers/tuples are literal-parsed by the runtime
+  // (the reference parses dmlc::Parameter strings the same way).
+  Op &SetAttr(const std::string &key, const std::string &value) {
+    keys_.push_back(key);
+    vals_.push_back(value);
+    return *this;
+  }
+
+  std::vector<NDArray> Invoke(const std::vector<const NDArray *> &inputs) {
+    std::vector<void *> in;
+    for (const NDArray *x : inputs) in.push_back(x->handle());
+    std::vector<const char *> ck, cv;
+    for (size_t i = 0; i < keys_.size(); ++i) {
+      ck.push_back(keys_[i].c_str());
+      cv.push_back(vals_[i].c_str());
+    }
+    void *outs[8];
+    int num_out = 0;
+    lib_->Check(lib_->invoke_(
+        name_.c_str(), static_cast<int>(in.size()), in.data(),
+        static_cast<int>(ck.size()), ck.data(), cv.data(), 8, outs,
+        &num_out));
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i) result.emplace_back(lib_, outs[i]);
+    return result;
+  }
+
+  std::vector<NDArray> Invoke(
+      std::initializer_list<const NDArray *> inputs) {
+    return Invoke(std::vector<const NDArray *>(inputs));
+  }
+
+ private:
+  LibPtr lib_;
+  std::string name_;
+  std::vector<std::string> keys_, vals_;
+};
+
+class Symbol {
+ public:
+  static Symbol FromJSON(const LibPtr &lib, const std::string &json) {
+    void *h = nullptr;
+    lib->Check(lib->sym_from_json_(json.c_str(), &h));
+    return Symbol(lib, h);
+  }
+
+  Symbol(Symbol &&o) noexcept : lib_(std::move(o.lib_)), handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  ~Symbol() {
+    if (handle_ != nullptr && lib_ != nullptr) lib_->sym_free_(handle_);
+  }
+
+  std::string ToJSON() const { return StrCall(lib_->sym_to_json_); }
+
+  std::vector<std::string> ListArguments() const {
+    return SplitLines(StrCall(lib_->sym_list_arguments_));
+  }
+
+  std::vector<std::string> ListOutputs() const {
+    return SplitLines(StrCall(lib_->sym_list_outputs_));
+  }
+
+ private:
+  Symbol(LibPtr lib, void *handle)
+      : lib_(std::move(lib)), handle_(handle) {}
+
+  std::string StrCall(Lib::symto_fn fn) const {
+    long needed = 0;
+    lib_->Check(fn(handle_, nullptr, 0, &needed));
+    std::string out(static_cast<size_t>(needed), '\0');
+    lib_->Check(fn(handle_, &out[0], needed, &needed));
+    out.resize(std::strlen(out.c_str()));
+    return out;
+  }
+
+  static std::vector<std::string> SplitLines(const std::string &s) {
+    std::vector<std::string> out;
+    size_t start = 0;
+    while (start <= s.size()) {
+      size_t nl = s.find('\n', start);
+      if (nl == std::string::npos) {
+        if (start < s.size()) out.push_back(s.substr(start));
+        break;
+      }
+      out.push_back(s.substr(start, nl - start));
+      start = nl + 1;
+    }
+    return out;
+  }
+
+  LibPtr lib_;
+  void *handle_ = nullptr;
+};
+
+inline void WaitAll(const LibPtr &lib) { lib->Check(lib->wait_all_()); }
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

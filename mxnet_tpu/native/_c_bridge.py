@@ -1,0 +1,105 @@
+"""Python half of the core C ABI (src/native/c_api.cc).
+
+Reference: src/c_api/c_api.cc:275-414 — the NDArray CRUD / save / load
+surface — plus MXImperativeInvokeEx (src/c_api/c_api_ndarray.cc:81-143)
+and MXSymbolCreateFromJSON / MXSymbolSaveToJSON
+(src/c_api/c_api_symbolic.cc:500).  The C layer embeds CPython and calls
+these helpers; a handle on the C side IS a ``PyObject*`` of the value
+returned here (NDArray or Symbol), so lifetime is plain refcounting.
+
+Everything here is host-side glue: the arrays live wherever jax put them,
+and ops dispatch through the ordinary registry — the same path the Python
+frontend uses, which is what keeps the two surfaces value-identical.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as _np
+
+from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
+from ..ndarray import ndarray as _nd
+
+__all__ = [
+    "nd_zeros", "nd_from_bytes", "nd_shape", "nd_dtype_code", "nd_tobytes",
+    "nd_save", "nd_load", "invoke", "sym_from_json", "sym_to_json",
+    "sym_list_arguments", "sym_list_outputs", "wait_all",
+]
+
+
+def nd_zeros(shape, dtype_code):
+    return _nd.zeros(tuple(int(s) for s in shape),
+                     dtype=CODE_TO_DTYPE[int(dtype_code)])
+
+
+def nd_from_bytes(buf, shape, dtype_code):
+    dt = CODE_TO_DTYPE[int(dtype_code)]
+    arr = _np.frombuffer(buf, dtype=dt).reshape(
+        tuple(int(s) for s in shape))
+    return _nd.array(arr, dtype=dt)
+
+
+def nd_shape(h):
+    return tuple(int(s) for s in h.shape)
+
+
+def nd_dtype_code(h):
+    return DTYPE_TO_CODE[_np.dtype(h.dtype)]
+
+
+def nd_tobytes(h):
+    return h.asnumpy().tobytes()
+
+
+def nd_save(fname, names, arrays):
+    if names:
+        _nd.save(fname, dict(zip(names, arrays)))
+    else:
+        _nd.save(fname, list(arrays))
+
+
+def nd_load(fname):
+    """Returns (names, arrays); names are "" for list-style files —
+    the MXNDArrayLoad contract (reference c_api.cc:383-414)."""
+    data = _nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return names, [data[n] for n in names]
+    return [""] * len(data), list(data)
+
+
+def invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvokeEx analog: attrs arrive as strings (the reference
+    parses them through dmlc::Parameter); literal-parse numbers/tuples/
+    bools, leave the rest as strings.  Always returns a list of outputs."""
+    from ..ops.registry import invoke as _invoke
+    attrs = {}
+    for k, v in zip(keys, vals):
+        try:
+            attrs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            attrs[k] = v
+    out = _invoke(op_name, *inputs, **attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def sym_from_json(js):
+    from ..symbol.symbol import load_json
+    return load_json(js)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_list_arguments(sym):
+    return "\n".join(sym.list_arguments())
+
+
+def sym_list_outputs(sym):
+    return "\n".join(sym.list_outputs())
+
+
+def wait_all():
+    _nd.waitall()
+    return 0

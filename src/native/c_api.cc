@@ -1,0 +1,362 @@
+// Core C ABI: NDArray CRUD/save/load, imperative invoke, symbol JSON.
+//
+// Reference surface being mirrored: src/c_api/c_api.cc:275-414 (NDArray
+// create/free/save/load over handles), src/c_api/c_api_ndarray.cc:81-143
+// (MXImperativeInvokeEx), src/c_api/c_api_symbolic.cc:500
+// (MXSymbolSaveToJSON).  TPU-native re-design: a handle is an owned
+// PyObject* of an mxnet_tpu NDArray/Symbol, and every function dispatches
+// through mxnet_tpu/native/_c_bridge.py — the exact registry path the
+// Python frontend uses, which keeps both surfaces value-identical.
+//
+// Conventions:
+//   * return 0 on success, -1 on error (message via MXTpuCGetLastError)
+//   * string-out functions use the query/copy pattern: *needed is always
+//     set to strlen+1; the copy happens only when buf has room.
+//
+// Build: make -C src/native core_api   (links against libpython3).
+
+#include <cstring>
+
+#include "c_embed.h"
+
+namespace {
+
+using mxtpu::Gil;
+using mxtpu::set_error;
+using mxtpu::set_error_from_python;
+
+// The bridge module, imported once under the GIL.
+PyObject *bridge() {
+  static PyObject *mod = nullptr;
+  if (mod == nullptr) {
+    if (!mxtpu::pin_platform()) return nullptr;
+    mod = PyImport_ImportModule("mxnet_tpu.native._c_bridge");
+    if (mod == nullptr) set_error_from_python();
+  }
+  return mod;
+}
+
+// Call bridge.<fn>(args...) returning a new reference (nullptr on error,
+// with the error string already set).
+PyObject *bridge_call(const char *fn, PyObject *args) {
+  PyObject *mod = bridge();
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (res == nullptr) set_error_from_python();
+  return res;
+}
+
+PyObject *shape_tuple(const long *shape, int ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(shape[i]));
+  }
+  return t;
+}
+
+// Copy a Python str into the (buf, bufsize) slot, query/copy pattern.
+int str_out(PyObject *s, char *buf, long bufsize, long *needed) {
+  Py_ssize_t len = 0;
+  const char *c = PyUnicode_AsUTF8AndSize(s, &len);
+  if (c == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (needed != nullptr) *needed = static_cast<long>(len) + 1;
+  if (buf != nullptr && bufsize >= static_cast<long>(len) + 1) {
+    std::memcpy(buf, c, static_cast<size_t>(len) + 1);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTpuCGetLastError() {
+  std::lock_guard<std::mutex> lock(mxtpu::err_mutex());
+  return mxtpu::last_error().c_str();
+}
+
+// ---------------------------------------------------------------- NDArray
+
+// Zero-initialized array (reference MXNDArrayCreateEx, c_api.cc:275).
+// dtype_code follows the mshadow codes (f32=0 f64=1 f16=2 u8=3 i32=4
+// i8=5 i64=6, bf16=12 — mxnet_tpu/base.py DTYPE_TO_CODE).
+int MXTpuNDArrayCreate(const long *shape, int ndim, int dtype_code,
+                       void **out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_zeros", Py_BuildValue("(Ni)", shape_tuple(shape, ndim),
+                                dtype_code));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+// Array from a host buffer (MXNDArraySyncCopyFromCPU folded into create).
+int MXTpuNDArrayCreateFromBytes(const void *data, long nbytes,
+                                const long *shape, int ndim,
+                                int dtype_code, void **out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_from_bytes",
+      Py_BuildValue("(y#Ni)", static_cast<const char *>(data),
+                    static_cast<Py_ssize_t>(nbytes),
+                    shape_tuple(shape, ndim), dtype_code));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXTpuNDArrayFree(void *h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(h));
+  return 0;
+}
+
+int MXTpuNDArrayGetShape(void *h, long *dims, int max_ndim, int *out_ndim) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_shape", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  *out_ndim = static_cast<int>(n);
+  if (n > max_ndim) {
+    Py_DECREF(res);
+    set_error("MXTpuNDArrayGetShape: dims buffer too small");
+    return -1;  // required ndim is in *out_ndim
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    dims[i] = PyLong_AsLong(PyTuple_GetItem(res, i));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTpuNDArrayGetDType(void *h, int *out_code) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_dtype_code", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  *out_code = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// Synchronous copy-out (reference MXNDArraySyncCopyToCPU).  *out_nbytes
+// always reports the full payload size; the copy happens when buf fits.
+int MXTpuNDArrayGetData(void *h, void *buf, long bufsize,
+                        long *out_nbytes) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_tobytes", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  char *src = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(res, &src, &nbytes) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
+  }
+  if (out_nbytes != nullptr) *out_nbytes = static_cast<long>(nbytes);
+  if (buf != nullptr && bufsize >= static_cast<long>(nbytes)) {
+    std::memcpy(buf, src, static_cast<size_t>(nbytes));
+  } else if (buf != nullptr) {
+    Py_DECREF(res);
+    set_error("MXTpuNDArrayGetData: buffer too small");
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// Save named (keys != NULL) or anonymous arrays (reference MXNDArraySave,
+// c_api.cc:360 — same single-file format as mx.nd.save).
+int MXTpuNDArraySave(const char *fname, int num, void **handles,
+                     const char **keys) {
+  Gil gil;
+  PyObject *names = PyList_New(0);
+  PyObject *arrays = PyList_New(num);
+  for (int i = 0; i < num; ++i) {
+    if (keys != nullptr) {
+      PyObject *k = PyUnicode_FromString(keys[i]);
+      PyList_Append(names, k);
+      Py_DECREF(k);
+    }
+    Py_INCREF(static_cast<PyObject *>(handles[i]));
+    PyList_SET_ITEM(arrays, i, static_cast<PyObject *>(handles[i]));
+  }
+  PyObject *res = bridge_call(
+      "nd_save", Py_BuildValue("(sNN)", fname, names, arrays));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// Load a file into an opaque bundle; items are then fetched by index
+// (reference MXNDArrayLoad returns parallel arrays out of a ret store —
+// the bundle plays that role with explicit lifetime).
+int MXTpuNDArrayLoadCreate(const char *fname, void **out_bundle,
+                           int *out_count) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("nd_load", Py_BuildValue("(s)", fname));
+  if (res == nullptr) return -1;
+  PyObject *names = PyTuple_GetItem(res, 0);
+  if (names == nullptr || !PyList_Check(names)) {
+    Py_DECREF(res);
+    set_error("nd_load: malformed bridge result");
+    return -1;
+  }
+  *out_count = static_cast<int>(PyList_Size(names));
+  *out_bundle = res;
+  return 0;
+}
+
+// Borrowed name pointer stays valid while the bundle lives; the NDArray
+// handle is a NEW reference the caller frees with MXTpuNDArrayFree.
+int MXTpuNDArrayLoadGet(void *bundle, int i, void **out_nd,
+                        const char **out_name) {
+  Gil gil;
+  PyObject *b = static_cast<PyObject *>(bundle);
+  PyObject *names = PyTuple_GetItem(b, 0);
+  PyObject *arrays = PyTuple_GetItem(b, 1);
+  if (i < 0 || i >= PyList_Size(names)) {
+    set_error("MXTpuNDArrayLoadGet: index out of range");
+    return -1;
+  }
+  if (out_name != nullptr) {
+    *out_name = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  }
+  PyObject *nd = PyList_GetItem(arrays, i);
+  Py_INCREF(nd);
+  *out_nd = nd;
+  return 0;
+}
+
+int MXTpuNDArrayLoadFree(void *bundle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(bundle));
+  return 0;
+}
+
+// ------------------------------------------------------------- imperative
+
+// MXImperativeInvokeEx analog: run a registered op on NDArray handles.
+// Attrs are string key/value pairs (numbers/tuples literal-parsed by the
+// bridge, matching the reference's dmlc::Parameter string attrs).
+int MXTpuImperativeInvoke(const char *op_name, int num_in, void **ins,
+                          int num_attrs, const char **keys,
+                          const char **vals, int max_out, void **outs,
+                          int *num_out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *inputs = PyList_New(num_in);
+  for (int i = 0; i < num_in; ++i) {
+    Py_INCREF(static_cast<PyObject *>(ins[i]));
+    PyList_SET_ITEM(inputs, i, static_cast<PyObject *>(ins[i]));
+  }
+  PyObject *pk = PyList_New(num_attrs);
+  PyObject *pv = PyList_New(num_attrs);
+  for (int i = 0; i < num_attrs; ++i) {
+    PyList_SET_ITEM(pk, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pv, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *res = bridge_call(
+      "invoke", Py_BuildValue("(sNNN)", op_name, inputs, pk, pv));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  *num_out = static_cast<int>(n);
+  if (n > max_out) {
+    Py_DECREF(res);
+    set_error("MXTpuImperativeInvoke: outs buffer too small");
+    return -1;  // required count is in *num_out
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    outs[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// ----------------------------------------------------------------- symbol
+
+int MXTpuSymbolCreateFromJSON(const char *json, void **out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("sym_from_json", Py_BuildValue("(s)", json));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXTpuSymbolToJSON(void *h, char *buf, long bufsize, long *needed) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "sym_to_json", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  int rc = str_out(res, buf, bufsize, needed);
+  Py_DECREF(res);
+  return rc;
+}
+
+// Newline-joined argument names (reference MXSymbolListArguments).
+int MXTpuSymbolListArguments(void *h, char *buf, long bufsize,
+                             long *needed) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "sym_list_arguments",
+      Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  int rc = str_out(res, buf, bufsize, needed);
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXTpuSymbolListOutputs(void *h, char *buf, long bufsize, long *needed) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "sym_list_outputs",
+      Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  int rc = str_out(res, buf, bufsize, needed);
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXTpuSymbolFree(void *h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(h));
+  return 0;
+}
+
+// ------------------------------------------------------------------ misc
+
+// Reference MXNDArrayWaitAll: block until every queued computation is
+// visible (jax async dispatch drained).
+int MXTpuWaitAll() {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("wait_all", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"
