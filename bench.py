@@ -43,9 +43,15 @@ import time
 BASELINE_IMG_S = 363.69  # ResNet-50 fp32 train, 1xV100, BS128
 # The axon tunnel's observed failure mode is an init HANG that recovers on
 # ~tens-of-minutes scales (BENCH_r03: three 100s probes inside a 520s budget
-# were useless against a tunnel wedged for hours).  The watchdog is therefore
-# sized so the probe can wait out a recovery and still leave time to sweep.
-WATCHDOG_S = float(os.environ.get("MXTPU_BENCH_TIMEOUT", "2400"))
+# were useless against a tunnel wedged for hours).  The watchdog is sized so
+# the probe can wait out a recovery and still leave time to sweep — but it
+# MUST fire before the DRIVER's own kill window (~1800s observed in
+# BENCH_r04, rc=124 with no JSON): a watchdog that outlives the driver
+# prints nothing.  1650s leaves ~150s of margin to flush partial results.
+# Read the env directly (importing mxnet_tpu here would pull jax in before
+# the probe's watchdog exists); tests/test_op_sweep.py asserts this default
+# stays in sync with the bench.timeout_s knob in config.py.
+WATCHDOG_S = float(os.environ.get("MXTPU_BENCH_TIMEOUT", "1650"))
 SWEEP_RESERVE_S = 600.0  # watchdog slice kept for the actual benchmark sweep
 
 # ResNet-50 fwd FLOPs/image at 224x224 ~ 4.1e9; a train step ~ 3x fwd
@@ -229,8 +235,13 @@ def run_bench(runs_out):
         })
 
     def one_config(batch, dtype, iters, layout="native"):
+        # layout: "native" | "NHWC" | "NHWC_HWIO" (channels-last weights
+        # end-to-end — conv.weights_layout=HWIO, docs/PERF_NOTES.md)
         import mxnet_tpu.config as _cfg
-        _cfg.set("conv.internal_layout", layout)
+        _cfg.set("conv.internal_layout",
+                 "NHWC" if layout.startswith("NHWC") else "native")
+        _cfg.set("conv.weights_layout",
+                 "HWIO" if layout.endswith("HWIO") else "ref")
         data = rng.uniform(size=(batch, 3, 224, 224)).astype(np.float32)
         label = rng.randint(0, 1000, (batch,)).astype(np.float32)
         with jax.default_device(cpu0):
@@ -275,15 +286,17 @@ def run_bench(runs_out):
     # extra bf16 candidate; if it wins it becomes the headline (a real,
     # honest measurement — the layout is recorded per run)
     cfgs = [("bfloat16", 128, "native"), ("bfloat16", 128, "NHWC"),
-            ("bfloat16", 256, "native"), (None, 128, "native")] \
-        if on_tpu else [("bfloat16", 16, "native"),
-                        ("bfloat16", 16, "NHWC"), (None, 16, "native")]
+            ("bfloat16", 128, "NHWC_HWIO"), ("bfloat16", 256, "native"),
+            (None, 128, "native")] \
+        if on_tpu else [("bfloat16", 16, "native"), ("bfloat16", 16, "NHWC"),
+                        ("bfloat16", 16, "NHWC_HWIO"), (None, 16, "native")]
     for dtype, batch, layout in cfgs:
         try:
             one_config(batch, dtype, iters, layout)
         finally:
             import mxnet_tpu.config as _cfg
             _cfg.set("conv.internal_layout", "native")
+            _cfg.set("conv.weights_layout", "ref")
     # inference config last and fenced: training numbers are the headline,
     # so neither a watchdog kill nor an exception here may cost them
     try:

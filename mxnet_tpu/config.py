@@ -164,6 +164,16 @@ register_knob(
     "conv.internal_layout", "MXTPU_CONV_LAYOUT", str, "native",
     "internal conv layout: native (NCHW dimension numbers) or NHWC "
     "(channels-last inside the lowering; logical API stays NCHW).")
+register_knob(
+    "conv.weights_layout", "MXTPU_CONV_WEIGHTS_LAYOUT", str, "ref",
+    "conv weight storage inside SPMDTrainer: ref (OIHW — the reference "
+    "and checkpoint layout) or HWIO (channels-last END-TO-END: weights, "
+    "their gradients and optimizer state all live channels-last, so the "
+    "HBM-bound 1x1 convs never pay a weight relayout; docs/PERF_NOTES.md). "
+    "Single-file checkpoints are always converted to OIHW on save (and "
+    "back on load) so they stay interchangeable; sharded orbax "
+    "checkpoints store the active layout and must be reloaded under the "
+    "same knob.")
 
 # profiler (reference env_var.md:201-205)
 register_knob(
@@ -191,8 +201,9 @@ register_knob(
 
 # bench / testing
 register_knob(
-    "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 520.0,
-    "bench.py watchdog in seconds.")
+    "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 1650.0,
+    "bench.py watchdog in seconds; the default sits under the driver's "
+    "~1800s kill window so partial results always flush before rc=124.")
 register_knob(
     "test.seed", "MXNET_TEST_SEED", int, -1,
     "fixed seed for test_utils randomness; -1 draws a fresh one "

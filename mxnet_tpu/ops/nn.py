@@ -61,6 +61,7 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     pad = _tup(pad if pad is not None else 0, ndim)
     pad = pad if isinstance(pad[0], tuple) else tuple((p, p) for p in pad)
     nhwc = ndim == 2 and _nhwc_internal()
+    rhs_spec = "HWIO" if (ndim == 2 and _HWIO_WEIGHTS) else None
     if nhwc:
         # channels-LAST internal layout (docs/PERF_NOTES.md): channels map
         # to the TPU's 128-lane minor dimension, which is where the
@@ -69,10 +70,14 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         # convs, so only the graph edges pay a relayout.
         xin = jnp.transpose(x, (0, 2, 3, 1))
         dn = lax.conv_dimension_numbers(xin.shape, w.shape,
-                                        ("NHWC", "OIHW", "NHWC"))
+                                        ("NHWC", rhs_spec or "OIHW",
+                                         "NHWC"))
     else:
         xin = x
-        dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
+        lhs_spec, _, out_spec = _conv_dims(ndim)
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape,
+            (lhs_spec, rhs_spec or "OI" + "DHW"[-ndim:], out_spec))
     out = lax.conv_general_dilated(
         xin, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
@@ -89,6 +94,22 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
 def _nhwc_internal():
     from .. import config as _config
     return _config.get("conv.internal_layout") == "NHWC"
+
+
+# Trace-scoped flag: SPMDTrainer sets this while tracing its jitted step
+# after converting the conv weights it owns to HWIO (channels-last
+# end-to-end, docs/PERF_NOTES.md).  Module state rather than a config knob
+# so eager paths outside the trainer (which still hold OIHW weights) are
+# never misinterpreted.
+_HWIO_WEIGHTS = False
+
+
+def set_hwio_weights(on):
+    """Flip the HWIO weight interpretation; returns the previous value."""
+    global _HWIO_WEIGHTS
+    prev = _HWIO_WEIGHTS
+    _HWIO_WEIGHTS = bool(on)
+    return prev
 
 
 @register("Deconvolution", aliases=("deconvolution",))

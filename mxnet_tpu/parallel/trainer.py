@@ -40,6 +40,25 @@ def _opt_hyper_arrays(optimizer, num_params):
     return lrs, wds
 
 
+def _conv_weight_names(block):
+    """Names of 2-D convolution weight parameters in a Block tree — the
+    exact set the HWIO weight layout applies to."""
+    from ..gluon import nn as _gnn
+    names, seen = set(), set()
+
+    def walk(b):
+        if id(b) in seen:
+            return
+        seen.add(id(b))
+        if isinstance(b, _gnn.Conv2D):
+            names.add(b.weight.name)
+        for c in getattr(b, "_children", {}).values():
+            walk(c)
+
+    walk(block)
+    return names
+
+
 class SPMDTrainer:
     """Fused-step trainer for a Gluon block on a device mesh.
 
@@ -88,6 +107,13 @@ class SPMDTrainer:
         self._step_num = 0
         self._jitted = None
         self._donate = donate
+        # channels-last weights end-to-end (conv.weights_layout=HWIO,
+        # docs/PERF_NOTES.md): conv weights + grads + optimizer state live
+        # HWIO inside the trainer; boundaries (sync, single-file
+        # checkpoints) convert to/from the reference OIHW layout
+        from .. import config as _cfg
+        self._hwio = _cfg.get("conv.weights_layout") == "HWIO"
+        self._hwio_names = _conv_weight_names(block) if self._hwio else set()
 
     def _materialize(self, data):
         """Snapshot the Block's parameters into device-placed jax arrays.
@@ -106,16 +132,73 @@ class SPMDTrainer:
             self.block(_wrap(jnp.asarray(data)))
             self.fn = functionalize(self.block)
             vals = self.fn.init_values()
+        if self._hwio:
+            # the HWIO flag flips the interpretation of EVERY traced conv
+            # weight, but only nn.Conv2D weights were converted: a custom
+            # block with its own 4-D conv weight would silently compute
+            # wrong math (square kernel, C_in == C_out) — refuse loudly
+            unknown = [n for n in self.fn.trainable
+                       if getattr(vals.get(n), "ndim", 0) == 4
+                       and n not in self._hwio_names]
+            if unknown:
+                raise NotImplementedError(
+                    "conv.weights_layout=HWIO supports models whose conv "
+                    "weights belong to gluon nn.Conv2D blocks; found 4-D "
+                    "trainable params it cannot classify: %s — use the "
+                    "default 'ref' layout for this model" % unknown)
         self.params = {n: jnp.array(v) for n, v in vals.items()}
+        self.params = self._layout_internal(self.params)
         self.opt_state = {}
         for i, name in enumerate(self.fn.trainable):
             st = self.optimizer.create_state(i, _wrap(self.params[name]))
             self.opt_state[name] = _state_to_jax(st)
         self._place()
 
+    # -------------------------------------------------------- weight layout
+    def _layout_internal(self, params):
+        """OIHW -> HWIO for the conv weights this trainer owns (no-op when
+        the knob is off or a name is not a 4-D conv weight)."""
+        if not self._hwio_names:
+            return params
+        out = dict(params)
+        for n in self._hwio_names:
+            if n in out and getattr(out[n], "ndim", 0) == 4:
+                out[n] = jnp.transpose(out[n], (2, 3, 1, 0))
+        return out
+
+    def _layout_ref(self, params):
+        """HWIO -> OIHW (the reference/checkpoint layout) at boundaries."""
+        if not self._hwio_names:
+            return params
+        out = dict(params)
+        for n in self._hwio_names:
+            if n in out and getattr(out[n], "ndim", 0) == 4:
+                out[n] = jnp.transpose(out[n], (3, 2, 0, 1))
+        return out
+
+    def _layout_state(self, state, to_internal):
+        """Apply the weight-layout transpose to optimizer-state leaves
+        (momentum etc. shard and transpose with their weights)."""
+        if not self._hwio_names:
+            return state
+        perm = (2, 3, 1, 0) if to_internal else (3, 2, 0, 1)
+        out = dict(state)
+        for n in self._hwio_names:
+            if n in out and out[n] is not None:
+                out[n] = jax.tree_util.tree_map(
+                    lambda x: jnp.transpose(x, perm)
+                    if getattr(x, "ndim", 0) == 4 else x, out[n])
+        return out
+
     # ------------------------------------------------------------ placement
     def _spec_for(self, name):
-        return self._param_specs.get(name, P())  # default: replicated
+        spec = self._param_specs.get(name, P())  # default: replicated
+        if name in self._hwio_names and len(spec) > 0:
+            # user specs are written against the OIHW axis order; permute
+            # them with the weight so the same logical axis stays sharded
+            axes = tuple(spec) + (None,) * (4 - len(spec))
+            spec = P(*(axes[i] for i in (2, 3, 1, 0)))
+        return spec
 
     def _place(self):
         mesh = self.mesh
@@ -138,8 +221,10 @@ class SPMDTrainer:
                     for n in fn.params}
 
         cdt = self.compute_dtype
+        hwio = bool(self._hwio_names)
 
         def loss_of(train_params, aux_params, data, label, key):
+            from ..ops import nn as _nn_ops
             param_map = dict(aux_params)  # aux (BN stats) stay f32
             if cdt is not None:
                 param_map.update(
@@ -149,7 +234,12 @@ class SPMDTrainer:
                     data = data.astype(cdt)    # their dtype
             else:
                 param_map.update(train_params)
-            (out,), new_aux = fn.apply(param_map, (data,), key, training=True)
+            prev = _nn_ops.set_hwio_weights(hwio)
+            try:
+                (out,), new_aux = fn.apply(param_map, (data,), key,
+                                           training=True)
+            finally:
+                _nn_ops.set_hwio_weights(prev)
             if cdt is not None:
                 out = out.astype(jnp.float32)
             loss = _as_scalar_loss(loss_fn, out, label)
@@ -219,8 +309,9 @@ class SPMDTrainer:
         return loss
 
     def sync(self):
-        """Write device params back into the Block's Parameters."""
-        self.fn.write_back(self.params)
+        """Write device params back into the Block's Parameters (always in
+        the reference OIHW layout, whatever the internal layout is)."""
+        self.fn.write_back(self._layout_ref(self.params))
 
     # ---------------------------------------------------------- checkpoint
     def _ckpt_meta(self):
@@ -314,10 +405,14 @@ class SPMDTrainer:
         import numpy as np
         import pickle
         step_num, rng_key = self._ckpt_meta()
+        # single-file checkpoints always carry the reference OIHW layout so
+        # they stay interchangeable across conv.weights_layout settings
+        ref_params = self._layout_ref(self.params)
+        ref_state = self._layout_state(self.opt_state, to_internal=False)
         host = {
             "step_num": step_num,
-            "params": {n: _to_host(v) for n, v in self.params.items()},
-            "opt_state": jax.tree_util.tree_map(_to_host, self.opt_state),
+            "params": {n: _to_host(v) for n, v in ref_params.items()},
+            "opt_state": jax.tree_util.tree_map(_to_host, ref_state),
             # The eager PRNG stream position: models that draw per step
             # (dropout, SGLD) must resume on the same key sequence for the
             # bitwise-continue guarantee to hold.
@@ -335,8 +430,10 @@ class SPMDTrainer:
             host = pickle.load(f)
         self._step_num = host["step_num"]
         self.optimizer.num_update = self._step_num
-        self.params = {n: jnp.asarray(v) for n, v in host["params"].items()}
-        self.opt_state = host["opt_state"]
+        self.params = self._layout_internal(
+            {n: jnp.asarray(v) for n, v in host["params"].items()})
+        self.opt_state = self._layout_state(host["opt_state"],
+                                            to_internal=True)
         self._place()
         if "rng_key" in host:
             _random._STATE.key = jnp.asarray(host["rng_key"])

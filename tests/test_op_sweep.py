@@ -9,6 +9,8 @@ Coverage is ENFORCED: an op registered without a sweep spec (and not in the
 reasoned exemption table) fails test_every_op_has_spec — nothing is skipped
 silently.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -994,3 +996,16 @@ def test_op_forward_and_grad(name):
             r = apply_op(op, *nds, **s["attrs"])
             return r[0] if isinstance(r, (list, tuple)) else r
         check_numeric_gradient(f, arrays)
+
+
+def test_bench_watchdog_default_matches_knob():
+    """bench.py reads MXTPU_BENCH_TIMEOUT directly (importing the package
+    there would touch jax before the probe watchdog exists); this pins its
+    hand-written default to the documented bench.timeout_s knob."""
+    import re
+    import mxnet_tpu.config as cfg
+    src = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")).read()
+    m = re.search(r'MXTPU_BENCH_TIMEOUT",\s*"([\d.]+)"', src)
+    assert m, "bench.py watchdog default not found"
+    assert float(m.group(1)) == cfg.knobs()["bench.timeout_s"].default

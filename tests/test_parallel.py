@@ -279,3 +279,72 @@ def test_spmd_trainer_sharded_checkpoint_resume_bitwise(tmp_path):
     for n in tr_full.params:
         np.testing.assert_array_equal(np.asarray(tr_full.params[n]),
                                       np.asarray(tr_b.params[n]))
+
+
+def test_hwio_weights_layout_value_parity(tmp_path):
+    """conv.weights_layout=HWIO (channels-last weights end-to-end,
+    docs/PERF_NOTES.md): identical math to the reference OIHW layout —
+    same loss curve, same synced-back weights, and single-file
+    checkpoints interchange across the knob."""
+    import mxnet_tpu.config as cfg
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(size=(8, 3, 12, 12)).astype(np.float32)
+    label = rng.randint(0, 5, (8,)).astype(np.float32)
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, in_channels=3),
+                nn.Activation("relu"),
+                nn.Conv2D(8, 1, in_channels=8),   # the 1x1 the layout targets
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(5))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.array(data))  # resolve shapes identically for both runs
+        return net
+
+    mx.random.seed(7)
+    net_ref = build()
+    mx.random.seed(7)
+    net_hwio = build()
+    for (a, pa), (b, pb) in zip(net_ref.collect_params().items(),
+                                net_hwio.collect_params().items()):
+        np.testing.assert_array_equal(pa.data().asnumpy(),
+                                      pb.data().asnumpy())
+
+    def train(net, layout):
+        cfg.set("conv.weights_layout", layout)
+        try:
+            tr = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9},
+                             mesh=make_mesh({"dp": -1}))
+            losses = [float(np.asarray(tr.step(data, label)))
+                      for _ in range(3)]
+            tr.sync()
+            return tr, losses
+        finally:
+            cfg.set("conv.weights_layout", "ref")
+
+    tr_ref, losses_ref = train(net_ref, "ref")
+    tr_hwio, losses_hwio = train(net_hwio, "HWIO")
+    assert tr_hwio._hwio_names, "HWIO trainer found no conv weights"
+    np.testing.assert_allclose(losses_hwio, losses_ref, rtol=2e-5)
+    for (n, pr), (_, ph) in zip(net_ref.collect_params().items(),
+                                net_hwio.collect_params().items()):
+        np.testing.assert_allclose(ph.data().asnumpy(),
+                                   pr.data().asnumpy(), rtol=2e-4,
+                                   atol=1e-6)
+
+    # checkpoint interop: HWIO-saved file resumes a ref-layout trainer
+    ck = str(tmp_path / "hwio.ckpt")
+    tr_hwio.save_checkpoint(ck)
+    w_hwio = {n: v for n, v in tr_hwio.params.items()}
+    tr_ref.load_checkpoint(ck)
+    for n in tr_ref.params:
+        a = np.asarray(tr_ref.params[n])
+        b = np.asarray(w_hwio[n])
+        if n in tr_hwio._hwio_names and b.ndim == 4:
+            b = b.transpose(3, 2, 0, 1)
+        np.testing.assert_allclose(a, b, rtol=1e-6)

@@ -62,6 +62,21 @@ def _build_net(model):
 
 def bench_training_scaling(model="resnet50", per_device_batch=32, iters=20,
                            max_devices=None):
+    """Compute-normalized weak scaling.
+
+    On an oversubscribed host (N virtual devices sharing few cores) raw
+    weak-scaling throughput measures the oversubscription, not the
+    harness.  So each device count runs the SAME global batch twice:
+
+      * sharded — dp mesh of n devices, gradients psum'd (the real path);
+      * unsharded — one device, identical math, no collectives.
+
+    Both runs execute the same total FLOPs on the same silicon, so their
+    ratio cancels the compute and isolates what sharding adds:
+    ``collective_overhead_fraction = 1 - t_unsharded / t_sharded``.
+    On real multi-chip hardware the sharded run is also a true
+    throughput measurement (img_s is reported either way).
+    """
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
@@ -71,11 +86,8 @@ def bench_training_scaling(model="resnet50", per_device_batch=32, iters=20,
     results = []
     net, shape = _build_net(model)
     rng = np.random.RandomState(0)
-    base = None
-    for nd_ in _devices_sweep(max_devices):
-        batch = per_device_batch * nd_
-        data = rng.uniform(size=(batch,) + shape).astype(np.float32)
-        label = rng.randint(0, 10, (batch,)).astype(np.float32)
+
+    def timed_step(nd_, batch, data, label):
         mesh = Mesh(np.asarray(jax.devices()[:nd_]), ("dp",))
         tr = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
                          {"learning_rate": 0.05, "momentum": 0.9},
@@ -85,23 +97,50 @@ def bench_training_scaling(model="resnet50", per_device_batch=32, iters=20,
         np.asarray(loss)          # compile + settle
         ddev = jax.device_put(jnp.asarray(data), tr._batch_sharding)
         ldev = jax.device_put(jnp.asarray(label), tr._batch_sharding)
+        loss = tr.step(ddev, ldev)
+        np.asarray(loss)          # warm with device-resident data
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = tr.step(ddev, ldev)
         np.asarray(loss)
-        dt = time.perf_counter() - t0
-        img_s = batch * iters / dt
-        if base is None:
-            base = img_s
-        results.append({
+        return (time.perf_counter() - t0) / iters
+
+    # the unsharded control only makes sense on an oversubscribed virtual
+    # mesh: real chips measure true weak scaling directly, and one chip
+    # could not hold (or fairly time) the n-device global batch anyway
+    normalize = jax.devices()[0].platform == "cpu"
+    base_img_s = None
+    for nd_ in _devices_sweep(max_devices):
+        batch = per_device_batch * nd_
+        data = rng.uniform(size=(batch,) + shape).astype(np.float32)
+        label = rng.randint(0, 10, (batch,)).astype(np.float32)
+        t_sharded = timed_step(nd_, batch, data, label)
+        img_s = batch / t_sharded
+        if base_img_s is None:
+            base_img_s = img_s
+        row = {
             "devices": nd_,
             "global_batch": batch,
             "img_s": round(img_s, 2),
-            "scaling_efficiency": round(img_s / (base * nd_), 4),
-        })
-        print("devices=%d batch=%d: %.1f samples/s (eff %.1f%%)"
-              % (nd_, batch, img_s,
-                 100 * results[-1]["scaling_efficiency"]), flush=True)
+            "t_sharded_ms": round(t_sharded * 1e3, 2),
+        }
+        if normalize:
+            t_single = timed_step(1, batch, data, label) if nd_ > 1 \
+                else t_sharded
+            overhead = max(0.0, 1.0 - t_single / t_sharded)
+            row["t_unsharded_same_flops_ms"] = round(t_single * 1e3, 2)
+            row["collective_overhead_fraction"] = round(overhead, 4)
+            print("devices=%d batch=%d: %.1f samples/s, sharding overhead "
+                  "%.1f%% (%.1fms vs %.1fms unsharded)"
+                  % (nd_, batch, row["img_s"], 100 * overhead,
+                     t_sharded * 1e3, t_single * 1e3), flush=True)
+        else:
+            row["scaling_efficiency"] = round(
+                img_s / (base_img_s * nd_), 4)
+            print("devices=%d batch=%d: %.1f samples/s (eff %.1f%%)"
+                  % (nd_, batch, row["img_s"],
+                     100 * row["scaling_efficiency"]), flush=True)
+        results.append(row)
     return results
 
 
@@ -143,6 +182,43 @@ def bench_allreduce_bandwidth(sizes_mb=(1, 16, 64), max_devices=None):
     return results
 
 
+def _measured_single_chip():
+    """Best measured **bf16** train img/s, sourced from committed bench
+    artifacts with provenance.  Priority: driver-captured beats
+    session-measured beats the session-claimed constant; within one
+    provenance tier the higher throughput wins.  Artifacts whose headline
+    is a different dtype (e.g. the fp32 early-harness BENCH_r02) are
+    excluded — t_comp here explicitly models the bf16 step."""
+    import glob
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tiers = {"driver-captured": 0, "session-measured": 1}
+    best = None
+    for path in sorted(glob.glob(os.path.join(root, "BENCH*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") or rec  # driver writes parsed: null on rc!=0
+        val = parsed.get("value", 0) or 0
+        if parsed.get("platform") == "cpu" or val <= 0:
+            continue
+        if parsed.get("dtype") != "bfloat16":
+            continue  # fp32 or dtype-less early-schema artifacts don't model bf16 t_comp
+        prov = ("session-measured" if "SESSION" in path
+                else "driver-captured")
+        cand = {"img_s": val, "provenance": prov,
+                "source": os.path.basename(path)}
+        if best is None or (tiers[prov], -val) < \
+                (tiers[best["provenance"]], -best["img_s"]):
+            best = cand
+    if best is None:
+        best = {"img_s": 2560.0, "provenance": "session-claimed",
+                "source": "docs/PERF_NOTES.md round-3 measurement "
+                          "(no bf16 bench artifact with a nonzero value)"}
+    return best
+
+
 def analytic_projection():
     """Project dp weak-scaling efficiency to chip counts this host cannot
     hold, against the reference's published north star (90.1%% at 256
@@ -155,8 +231,9 @@ def analytic_projection():
     explicit, auditable assumption in the emitted record:
 
     * grad_bytes — 25.6M ResNet-50 params in bf16 (2 bytes);
-    * t_comp — from the measured single-chip 2560 img/s at BS128
-      (README, builder-session measurement; rescaled if that changes);
+    * t_comp — from the best committed bench artifact (BENCH*.json); the
+      emitted img_s_provenance names the file and whether it was
+      driver-captured, session-measured, or a session-claimed fallback;
     * ICI — 4 links x 100 GB/s/dir per v5e chip, ring uses 2 concurrent
       directions => 200 GB/s bus per chip pair (public v5e figure);
     * DCN — 25 GB/s per host (8 chips share it), the cross-pod fallback;
@@ -165,7 +242,8 @@ def analytic_projection():
       ready); a deliberately conservative figure.
     """
     grad_bytes = 25.6e6 * 2
-    img_s_1chip = 2560.0
+    measured = _measured_single_chip()
+    img_s_1chip = measured["img_s"]
     t_comp = 128.0 / img_s_1chip          # s/step at BS128/chip
     ici_bus = 200e9
     dcn_bus_per_chip = 25e9 / 8
@@ -189,6 +267,7 @@ def analytic_projection():
         "assumptions": {
             "grad_bytes": grad_bytes,
             "img_s_1chip_bf16_bs128": img_s_1chip,
+            "img_s_provenance": measured,
             "ici_bus_gb_s": ici_bus / 1e9,
             "dcn_bus_per_chip_gb_s": dcn_bus_per_chip / 1e9,
             "overlap": overlap,
@@ -225,11 +304,17 @@ def main():
     platform = jax.devices()[0].platform
     out = {
         "platform": platform,
+        "model": args.model,
+        "per_device_batch": args.per_device_batch,
+        "iters": args.iters,
         "virtual_mesh": platform == "cpu",
-        "note": ("CPU virtual-mesh numbers validate the SPMD harness and "
-                 "sharding (not silicon); the analytic projection carries "
-                 "the multi-chip efficiency claim until real chips are "
-                 "attached" if platform == "cpu" else
+        "note": ("CPU virtual-mesh run: the training table is "
+                 "COMPUTE-NORMALIZED — each row times the same global "
+                 "batch sharded vs unsharded on the same silicon, so "
+                 "collective_overhead_fraction is the harness+collective "
+                 "cost, not CPU oversubscription; the analytic projection "
+                 "carries the multi-chip efficiency claim until real "
+                 "chips are attached" if platform == "cpu" else
                  "real-device measurement"),
         "training": bench_training_scaling(
             args.model, args.per_device_batch, args.iters,
