@@ -1,4 +1,7 @@
 """Neural network layers (reference: python/mxnet/gluon/nn/)."""
+# the reference re-exports the Block classes here (gluon/nn/__init__.py:
+# "from ..block import *") — user code writes gluon.nn.HybridBlock
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *

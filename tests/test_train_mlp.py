@@ -177,3 +177,46 @@ def test_train_lenet_converges(mnist_dir):
         with open(out, "a") as f:
             f.write(json.dumps({"model": "lenet_gluon",
                                 "final_val_acc": round(acc, 4)}) + "\n")
+
+
+def test_train_bf16_mixed_precision_converges(mnist_dir):
+    """Mixed-precision training convergence (reference train-suite
+    tests/python/train/test_dtype.py float16 analog): the bf16 policy —
+    f32 master weights, bf16 compute on the conv/matmul path — reaches
+    the same accuracy class as f32 on the glyph task."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 5, in_channels=1), nn.MaxPool2D(2, 2),
+            nn.Activation("relu"), nn.Flatten(),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    # batch divides both the 8-device virtual dp mesh (conftest) and the
+    # 1000-sample val set, so no wrap-padded duplicates skew the accuracy
+    train, val = _iters(mnist_dir, batch_size=200, flat=False)
+    tr = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9},
+                     mesh=make_mesh({"dp": -1}), dtype="bfloat16")
+    for _ in range(2):
+        for batch in train:
+            tr.step(batch.data[0], batch.label[0])
+        train.reset()
+    tr.sync()
+
+    correct = total = 0
+    for batch in val:
+        pred = net(batch.data[0]).asnumpy().argmax(axis=1)
+        yy = batch.label[0].asnumpy().astype(int)
+        correct += int((pred == yy).sum())
+        total += len(yy)
+    acc = correct / total
+    assert acc > 0.93, "bf16 training did not converge: val acc %.3f" % acc
+
+    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
+    if out:
+        with open(out, "a") as f:
+            f.write(json.dumps({"model": "lenet_bf16_spmd",
+                                "final_val_acc": round(acc, 4)}) + "\n")

@@ -148,9 +148,21 @@ def main():
                     help="use opperf's larger tensor shapes")
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--json", default=None, help="also write JSON here")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the host CPU backend via jax.config (the "
+                         "JAX_PLATFORMS env var is overridden by this "
+                         "environment's sitecustomize); REQUIRED on hosts "
+                         "where the default platform is a single-client "
+                         "device tunnel another process may be using")
     args = ap.parse_args()
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
     ops = args.ops.split(",") if args.ops else None
     results = run_performance_test(ops, large=args.large, runs=args.runs)
+    for r in results:
+        r["platform"] = platform
     print("%-24s %-28s %12s %12s" % ("Op", "Shapes", "Fwd(ms)",
                                      "Fwd+Bwd(ms)"))
     for r in results:
