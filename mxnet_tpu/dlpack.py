@@ -1,0 +1,91 @@
+"""DLPack interop — zero-copy tensor exchange with torch/numpy/cupy etc.
+
+Reference: python/mxnet/ndarray/ndarray.py:2846-2907 (to_dlpack_for_read /
+to_dlpack_for_write / from_dlpack over the vendored dlpack headers,
+SURVEY §vendored deps).  TPU-native: jax.Array speaks the modern DLPack
+protocol on CPU/GPU; TPU buffers are NOT dlpack-exportable (no external
+consumer can address TPU HBM), so exporting a TPU-resident array first
+lands a host copy — DLPack here is the HOST-interchange boundary, exactly
+like ``asnumpy``.
+
+One deliberate difference: ``to_dlpack_for_write`` raises.  The reference
+hands out a mutable aliased view ordered by its dependency engine; XLA
+buffers are immutable, so an external in-place write could never propagate
+and silently corrupting the consumer's expectation is worse than refusing
+(docs/MIGRATION.md mutation notes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+_KDLCPU = (1, 0)  # DLDeviceType kDLCPU, device id 0
+
+
+def _exportable(data):
+    """The jax array a DLPack consumer may address: the buffer itself on
+    CPU/GPU, a host copy for TPU-resident arrays (pending writes settle
+    first — the reference's WaitToRead ordering)."""
+    if isinstance(data, NDArray):
+        data = data._data
+    data = jax.block_until_ready(data)
+    try:
+        platform = next(iter(data.devices())).platform
+    except Exception:  # noqa: BLE001 — tracers/odd arrays: let jax decide
+        return data
+    if platform not in ("cpu", "gpu", "cuda", "rocm"):
+        cpu0 = jax.local_devices(backend="cpu")[0]
+        data = jax.block_until_ready(jax.device_put(data, cpu0))
+    return data
+
+
+def dlpack_device(data):
+    """__dlpack_device__ for an NDArray: the real device on CPU/GPU,
+    kDLCPU for platforms whose export lands a host copy."""
+    if isinstance(data, NDArray):
+        data = data._data
+    try:
+        return data.__dlpack_device__()
+    except Exception:  # noqa: BLE001 — e.g. BufferError on TPU
+        return _KDLCPU
+
+
+def to_dlpack_for_read(data, **kwargs):
+    """Export as a DLPack capsule (the single export path — the NDArray
+    ``__dlpack__`` protocol method delegates here)."""
+    return _exportable(data).__dlpack__(**kwargs)
+
+
+def to_dlpack_for_write(data):
+    raise NotImplementedError(
+        "to_dlpack_for_write: XLA buffers are immutable — an external "
+        "in-place write could not propagate back. Export with "
+        "to_dlpack_for_read and re-import the result instead.")
+
+
+class _CapsuleWrapper:
+    """Adapter: jax 0.9 jnp.from_dlpack consumes only protocol-speaking
+    objects, but the reference contract passes the raw PyCapsule that
+    to_dlpack_for_read returned.  Our capsules always describe host
+    memory (see _exportable), hence kDLCPU."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return _KDLCPU
+
+
+def from_dlpack(ext):
+    """Import a DLPack capsule or any ``__dlpack__``-speaking tensor
+    (torch, numpy, cupy) as an NDArray."""
+    if not hasattr(ext, "__dlpack__"):
+        ext = _CapsuleWrapper(ext)
+    return _wrap(jnp.from_dlpack(ext))

@@ -10,6 +10,8 @@ from __future__ import annotations
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       linspace, concat, stack, split, where, save, load,
                       waitall, from_jax)
+from ..dlpack import (to_dlpack_for_read, to_dlpack_for_write,  # noqa: F401
+                      from_dlpack)
 from .. import random  # noqa: F401 — nd.random.* parity
 from . import sparse  # noqa: F401 — nd.sparse.* (row_sparse/csr) parity
 from . import contrib  # noqa: F401 — nd.contrib.* parity
@@ -17,7 +19,8 @@ from ..ops import registry as _registry
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "eye", "linspace", "concat", "stack", "split", "where", "save",
-           "load", "waitall", "random", "sparse", "from_jax"]
+           "load", "waitall", "random", "sparse", "from_jax",
+           "to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
 
 
 def zeros_like(data):

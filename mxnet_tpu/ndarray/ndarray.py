@@ -132,6 +132,25 @@ class NDArray:
             yield self[i]
 
     # ------------------------------------------------------ host interchange
+    # DLPack protocol: torch.from_dlpack(nd) / np.from_dlpack(nd) work
+    # directly (reference: ndarray.py:2846 to_dlpack_for_read family).
+    # Export of TPU-resident arrays lands a host copy — see mx.dlpack.
+    def __dlpack__(self, **kwargs):
+        from ..dlpack import to_dlpack_for_read
+        return to_dlpack_for_read(self, **kwargs)
+
+    def __dlpack_device__(self):
+        from ..dlpack import dlpack_device
+        return dlpack_device(self)
+
+    def to_dlpack_for_read(self):
+        from ..dlpack import to_dlpack_for_read
+        return to_dlpack_for_read(self)
+
+    def to_dlpack_for_write(self):
+        from ..dlpack import to_dlpack_for_write
+        return to_dlpack_for_write(self)
+
     def asnumpy(self) -> _np.ndarray:
         return _np.asarray(self._data)
 

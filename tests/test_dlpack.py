@@ -1,0 +1,52 @@
+"""DLPack interop (reference: tests/python/unittest/test_dlpack.py and the
+ndarray.py:2846 to_dlpack family): tensors cross framework boundaries
+without value change — including a REAL torch round trip, since torch
+ships in this image."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_dlpack_capsule_roundtrip():
+    """The canonical reference pattern: from_dlpack(to_dlpack_for_read(x))
+    with the raw PyCapsule in between (ndarray.py:2858-2861)."""
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    cap = mx.nd.to_dlpack_for_read(x)
+    back = mx.nd.from_dlpack(cap)
+    assert isinstance(back, mx.nd.NDArray)
+    np.testing.assert_array_equal(back.asnumpy(), x.asnumpy())
+    # method form too
+    cap2 = x.to_dlpack_for_read()
+    np.testing.assert_array_equal(mx.nd.from_dlpack(cap2).asnumpy(),
+                                  x.asnumpy())
+
+
+def test_dlpack_protocol_numpy():
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    np.testing.assert_array_equal(np.from_dlpack(x), x.asnumpy())
+
+
+def test_dlpack_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    # mx -> torch (protocol path)
+    x = mx.nd.array(np.random.RandomState(0).normal(
+        size=(4, 5)).astype(np.float32))
+    t = torch.from_dlpack(x)
+    np.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    # torch -> mx
+    src = torch.arange(10, dtype=torch.float32) * 0.5
+    y = mx.nd.from_dlpack(src)
+    assert isinstance(y, mx.nd.NDArray)
+    np.testing.assert_array_equal(y.asnumpy(), src.numpy())
+    # imported values participate in ordinary ops
+    z = (y + y).asnumpy()
+    np.testing.assert_array_equal(z, src.numpy() * 2)
+
+
+def test_dlpack_write_refuses():
+    x = mx.nd.ones((2, 2))
+    with pytest.raises(NotImplementedError, match="immutable"):
+        mx.nd.to_dlpack_for_write(x)
+    with pytest.raises(NotImplementedError):
+        x.to_dlpack_for_write()

@@ -1,0 +1,84 @@
+"""Initializer behavior (reference: tests/python/unittest/test_init.py) —
+statistical and exact-value contracts per initializer, not just "it ran"."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+
+
+def _gen(ini, shape, name="weight"):
+    key = mx.random.new_eager_seed_key()
+    return np.asarray(ini.generate(key, shape, name=name))
+
+
+def test_constant_zero_one():
+    assert np.all(_gen(init.Zero(), (3, 4)) == 0)
+    assert np.all(_gen(init.One(), (3, 4)) == 1)
+    assert np.all(_gen(init.Constant(2.5), (5,)) == 2.5)
+
+
+def test_uniform_normal_ranges():
+    u = _gen(init.Uniform(0.3), (2000,))
+    assert u.min() >= -0.3 and u.max() <= 0.3
+    assert abs(u.mean()) < 0.02
+    n = _gen(init.Normal(0.5), (4000,))
+    assert abs(n.std() - 0.5) < 0.05 and abs(n.mean()) < 0.05
+
+
+def test_xavier_variance_matches_fan():
+    """Xavier 'uniform': bound = sqrt(6/(fan_in+fan_out)); variance of
+    U(-b, b) is b^2/3 (reference initializer.py Xavier docs)."""
+    shape = (256, 128)
+    w = _gen(init.Xavier(factor_type="avg", magnitude=3), shape)
+    bound = np.sqrt(3.0 * 2.0 / (shape[0] + shape[1]))
+    assert w.min() >= -bound - 1e-6 and w.max() <= bound + 1e-6
+    assert abs(w.var() - bound ** 2 / 3) < bound ** 2 / 10
+
+
+def test_msraprelu_gaussian_fan_in():
+    shape = (512, 64)
+    w = _gen(init.MSRAPrelu(factor_type="in", slope=0.0), shape)
+    expected_std = np.sqrt(2.0 / 64)  # fan_in of (out, in) weights
+    assert abs(w.std() - expected_std) / expected_std < 0.15
+
+
+def test_orthogonal_is_orthogonal():
+    """Rows are mutually orthogonal with uniform norm scale^2 (the
+    reference's default scale is 1.414 ~ sqrt(2))."""
+    w = _gen(init.Orthogonal(), (64, 64))
+    gram = w @ w.T
+    diag = np.diag(gram).mean()
+    np.testing.assert_allclose(gram, np.eye(64) * diag, atol=1e-4)
+    assert abs(diag - 2.0) < 0.05
+
+
+def test_bilinear_upsampling_kernel():
+    """Exact values: a 2x-upsampling 4x4 bilinear kernel is the outer
+    product of [0.25, 0.75, 0.75, 0.25] with itself (the reference's
+    deconv upsampling recipe)."""
+    w = _gen(init.Bilinear(), (1, 1, 4, 4))[0, 0]
+    v = np.array([0.25, 0.75, 0.75, 0.25])
+    np.testing.assert_allclose(w, np.outer(v, v), atol=1e-6)
+
+
+def test_lstmbias_forget_gate():
+    b = _gen(init.LSTMBias(forget_bias=1.0), (4 * 8,))
+    assert np.all(b[8:16] == 1.0)           # forget-gate rows
+    assert np.all(b[:8] == 0) and np.all(b[16:] == 0)
+
+
+def test_mixed_pattern_dispatch():
+    mixed = init.Mixed([".*bias", ".*"], [init.Zero(), init.One()])
+    key = mx.random.new_eager_seed_key()
+    assert np.all(np.asarray(mixed.generate(key, (4,),
+                                            name="fc1_bias")) == 0)
+    assert np.all(np.asarray(mixed.generate(key, (4,),
+                                            name="fc1_weight")) == 1)
+
+
+def test_initializer_registry_create_and_dumps():
+    ini = init.create("xavier", magnitude=2.0)
+    assert isinstance(ini, init.Xavier)
+    import json
+    name, kwargs = json.loads(ini.dumps())
+    assert name.lower() == "xavier" and kwargs["magnitude"] == 2.0
