@@ -14,6 +14,8 @@ _sys.path.insert(
     0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
 
 import argparse
+
+import _common
 import time
 
 import numpy as np
@@ -34,7 +36,9 @@ def main():
                     choices=["float32", "bfloat16"])
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel axis size")
+    _common.add_device_flag(ap)
     args = ap.parse_args()
+    _common.apply_device_flag(args)
 
     import jax
     import jax.numpy as jnp

@@ -13,6 +13,8 @@ _sys.path.insert(
     0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
 
 import argparse
+
+import _common
 import time
 
 import numpy as np
@@ -49,7 +51,9 @@ def main():
                     help="dir with MNIST idx files; synthetic when unset")
     ap.add_argument("--samples", type=int, default=2048,
                     help="synthetic train-set size")
+    _common.add_device_flag(ap)
     args = ap.parse_args()
+    _common.apply_device_flag(args)
 
     if args.data_dir:
         train_iter = mx.io.MNISTIter(

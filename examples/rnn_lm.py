@@ -14,6 +14,8 @@ _sys.path.insert(
     0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
 
 import argparse
+
+import _common
 import math
 import time
 
@@ -75,7 +77,9 @@ def main():
     ap.add_argument("--tokens", type=int, default=40000)
     ap.add_argument("--text", default=None,
                     help="tokenized text file (one int per whitespace)")
+    _common.add_device_flag(ap)
     args = ap.parse_args()
+    _common.apply_device_flag(args)
 
     if args.text:
         toks = np.loadtxt(args.text, dtype=np.int64).ravel()

@@ -13,6 +13,8 @@ _sys.path.insert(
     0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
 
 import argparse
+
+import _common
 import time
 
 import numpy as np
@@ -49,7 +51,9 @@ def main():
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--data-rec", default=None,
                     help="ImageDetRecordIter .rec; synthetic when unset")
+    _common.add_device_flag(ap)
     args = ap.parse_args()
+    _common.apply_device_flag(args)
 
     net = ssd_512(num_classes=args.num_classes)
     net.initialize(mx.init.Xavier())

@@ -15,6 +15,8 @@ _sys.path.insert(
     0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
 
 import argparse
+
+import _common
 import time
 
 import numpy as np
@@ -41,7 +43,9 @@ def main():
                     help="dp mesh size; -1 = all visible devices")
     ap.add_argument("--data-train", default=None, help=".rec file")
     ap.add_argument("--epochs", type=int, default=1)
+    _common.add_device_flag(ap)
     args = ap.parse_args()
+    _common.apply_device_flag(args)
 
     shape = tuple(int(s) for s in args.image_shape.split(","))
     mesh = make_mesh({"dp": args.num_devices})

@@ -38,8 +38,15 @@ def _exportable(data):
     except Exception:  # noqa: BLE001 — tracers/odd arrays: let jax decide
         return data
     if platform not in ("cpu", "gpu", "cuda", "rocm"):
-        cpu0 = jax.local_devices(backend="cpu")[0]
-        data = jax.block_until_ready(jax.device_put(data, cpu0))
+        try:
+            cpu0 = jax.local_devices(backend="cpu")[0]
+            data = jax.block_until_ready(jax.device_put(data, cpu0))
+        except RuntimeError:
+            # no CPU backend configured (jax_platforms pinned to the
+            # device): fall back to host bytes — numpy arrays speak the
+            # DLPack protocol themselves
+            import numpy as _np
+            data = _np.asarray(data)
     return data
 
 
@@ -85,7 +92,13 @@ class _CapsuleWrapper:
 
 def from_dlpack(ext):
     """Import a DLPack capsule or any ``__dlpack__``-speaking tensor
-    (torch, numpy, cupy) as an NDArray."""
+    (torch, numpy, cupy) as an NDArray.
+
+    Raw-capsule imports assume HOST memory — the only kind this
+    framework's own exports produce (capsules carry no queryable device
+    tag).  A capsule wrapping device memory from another framework must
+    come in as the framework's tensor object instead, whose
+    ``__dlpack_device__`` jax can consult."""
     if not hasattr(ext, "__dlpack__"):
         ext = _CapsuleWrapper(ext)
     return _wrap(jnp.from_dlpack(ext))

@@ -25,19 +25,29 @@ from .mesh import data_parallel_mesh
 __all__ = ["SPMDTrainer", "build_train_step"]
 
 
-def _opt_hyper_arrays(optimizer, num_params):
+def _opt_hyper_arrays(optimizer, num_params, cache=None):
     """Evaluate per-parameter lr/wd EAGERLY for the current num_update.
 
     These are fed into the jitted step as traced arguments so an
     ``lr_scheduler`` (reference: python/mxnet/lr_scheduler.py) keeps working —
     evaluating them at trace time would constant-fold the schedule into the
     compiled program and silently freeze it at the first step's value.
+
+    ``cache`` (a 1-slot dict) skips the two host->device uploads when the
+    schedule produced the same values as last step — on a tunneled device
+    every upload is a round trip, and constant-lr training would otherwise
+    pay two per step for identical bytes.
     """
-    lrs = jnp.asarray([optimizer._get_lr(i) for i in range(num_params)],
-                      jnp.float32)
-    wds = jnp.asarray([optimizer._get_wd(i) for i in range(num_params)],
-                      jnp.float32)
-    return lrs, wds
+    lr_host = tuple(optimizer._get_lr(i) for i in range(num_params))
+    wd_host = tuple(optimizer._get_wd(i) for i in range(num_params))
+    if cache is not None and cache.get("host") == (lr_host, wd_host):
+        return cache["dev"]
+    dev = (jnp.asarray(lr_host, jnp.float32),
+           jnp.asarray(wd_host, jnp.float32))
+    if cache is not None:
+        cache["host"] = (lr_host, wd_host)
+        cache["dev"] = dev
+    return dev
 
 
 def _conv_weight_names(block):
@@ -294,15 +304,26 @@ class SPMDTrainer:
         label = jax.device_put(jnp.asarray(label), self._batch_sharding)
         self._step_num += 1
         self.optimizer.num_update = self._step_num
-        lrs, wds = _opt_hyper_arrays(self.optimizer, len(self.fn.trainable))
+        if not hasattr(self, "_hyper_cache"):
+            self._hyper_cache = {}
+        lrs, wds = _opt_hyper_arrays(self.optimizer, len(self.fn.trainable),
+                                     self._hyper_cache)
         from .. import random as _random
         key = _random.new_eager_seed_key()
         train = {n: self.params[n] for n in self.fn.trainable}
         aux = {n: self.params[n] for n in self.fn.aux}
+        scales = self._hyper_cache.setdefault("scales", {})
+        # cache only plain-number scales (arrays are unhashable and a
+        # dynamic loss-scale would grow the cache unboundedly)
+        cacheable = isinstance(lr_scale, (int, float))
+        sarr = scales.get(lr_scale) if cacheable else None
+        if sarr is None:
+            sarr = jnp.asarray(lr_scale, jnp.float32)
+            if cacheable and len(scales) < 16:
+                scales[lr_scale] = sarr
         new_train, new_aux, self.opt_state, loss = self._jitted(
             train, aux, self.opt_state, data, label, key,
-            jnp.asarray(self._step_num, jnp.int32), lrs, wds,
-            jnp.asarray(lr_scale, jnp.float32))
+            jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
         self.params = {}
         self.params.update(new_train)
         self.params.update(new_aux)

@@ -38,3 +38,8 @@ def _seed_rng():
     mx.random.seed(42)
     _np.random.seed(42)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end tests (several minutes)")

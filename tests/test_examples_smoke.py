@@ -1,0 +1,47 @@
+"""Example-script smoke tests: every `examples/*.py` entry point runs to
+completion as a real CLI process (reference CI runs example scripts the
+same way, ci/docker/runtime_functions.sh).  Tiny configs, CPU-pinned via
+each script's --cpu flag — the scripts must never touch a tunneled TPU
+from inside the suite."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, os.path.join(ROOT, script),
+                        "--cpu", *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       cwd=ROOT, env=env)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    return r.stdout
+
+
+def test_gluon_mnist_example():
+    out = _run("examples/gluon_mnist.py", "--epochs", "1",
+               "--samples", "256", "--batch-size", "64")
+    assert "accuracy" in out.lower() or "epoch" in out.lower()
+
+
+def test_rnn_lm_example():
+    out = _run("examples/rnn_lm.py", "--epochs", "1")
+    assert "ppl" in out.lower() or "perplexity" in out.lower() \
+        or "epoch" in out.lower()
+
+
+def test_bert_pretrain_example():
+    out = _run("examples/bert_pretrain.py", "--layers", "1", "--steps", "2")
+    assert "sequences/s" in out
+
+
+@pytest.mark.slow
+def test_ssd_train_example():
+    out = _run("examples/ssd_train.py", "--steps", "1", "--size", "128",
+               timeout=900)
+    assert "img/s" in out and "NMS" in out

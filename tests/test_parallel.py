@@ -348,3 +348,20 @@ def test_hwio_weights_layout_value_parity(tmp_path):
         if n in tr_hwio._hwio_names and b.ndim == 4:
             b = b.transpose(3, 2, 0, 1)
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_hyper_array_cache_tracks_schedule():
+    """The per-step lr/wd device arrays are reused while the schedule is
+    flat (no redundant host->device uploads over the tunnel) but a
+    schedule change busts the cache immediately."""
+    from mxnet_tpu.parallel.trainer import _opt_hyper_arrays
+    import mxnet_tpu.optimizer as opt
+    o = opt.create("sgd", learning_rate=0.1)
+    cache = {}
+    l1, w1 = _opt_hyper_arrays(o, 3, cache)
+    l2, w2 = _opt_hyper_arrays(o, 3, cache)
+    assert l1 is l2 and w1 is w2
+    o.set_learning_rate(0.05)
+    l3, _ = _opt_hyper_arrays(o, 3, cache)
+    assert l3 is not l1
+    assert abs(float(np.asarray(l3)[0]) - 0.05) < 1e-7
