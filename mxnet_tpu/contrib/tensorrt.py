@@ -1,28 +1,82 @@
 """TensorRT integration point (reference: python/mxnet/contrib/tensorrt.py).
 
-No TPU counterpart exists BY DESIGN: TensorRT is an NVIDIA inference
-engine; on TPU the inference engine is XLA itself, and the deployment
-artifact is serialized StableHLO (see mxnet_tpu.deploy.export_model — the
-analog of the reference's trt graph conversion + c_predict_api).  The
-reference entry points raise with that redirection instead of silently
-doing nothing.
+The reference's TensorRT path takes a trained (symbol, params), hands
+subgraphs to an inference engine, and returns an executor running the
+optimized graph (plus an FP16 toggle).  The TPU-native engine is XLA
+itself, so the same contract is honored with real behavior:
+
+* ``tensorrt_bind(sym, all_params=..., data=shape)`` returns an Executor
+  whose forward is the jit-fused inference graph — XLA plays TensorRT.
+* ``set_use_fp16(True)`` (env ``MXNET_TENSORRT_USE_FP16``, same knob
+  name as the reference) makes ``tensorrt_bind`` amp-convert the graph
+  and params to bfloat16 first — the TPU's reduced-precision inference
+  mode (``mx.amp``), standing in for TRT's FP16 engine.
+* ``init_tensorrt_params`` returns the params unchanged (copies): the
+  reference strips weights absorbed into TRT engine nodes
+  (contrib/tensorrt.py:37); XLA consumes every param through the
+  ordinary executor, so nothing is absorbed.
+
+StableHLO export (``mxnet_tpu.deploy``) remains the ahead-of-time
+deployment artifact; this module is the *bind-time* optimized-inference
+API for scripts written against the reference.
 """
 from __future__ import annotations
 
-__all__ = ["init_tensorrt_params", "tensorrt_bind", "set_use_fp16"]
+import os
 
-_MSG = ("TensorRT has no TPU counterpart; XLA is the inference engine. "
-        "Use mxnet_tpu.deploy.export_model / load_model (StableHLO) for "
-        "deployment, and mx.amp for reduced-precision inference.")
-
-
-def tensorrt_bind(*_a, **_k):
-    raise NotImplementedError(_MSG)
+__all__ = ["init_tensorrt_params", "tensorrt_bind", "set_use_fp16",
+           "get_use_fp16"]
 
 
-def init_tensorrt_params(*_a, **_k):
-    raise NotImplementedError(_MSG)
+def set_use_fp16(status):
+    """Toggle reduced-precision inference for tensorrt_bind (reference
+    knob name kept; on TPU 'fp16' means bfloat16 via mx.amp)."""
+    os.environ["MXNET_TENSORRT_USE_FP16"] = str(int(bool(status)))
 
 
-def set_use_fp16(*_a, **_k):
-    raise NotImplementedError(_MSG)
+def get_use_fp16():
+    return os.environ.get("MXNET_TENSORRT_USE_FP16", "0") == "1"
+
+
+def _normalize_params(params):
+    """One params dict in either convention -> plain-name dict (the
+    canonical 'arg:'/'aux:' split lives in mxnet_tpu.model)."""
+    if any(k.startswith(("arg:", "aux:")) for k in params):
+        from .. import model as _model
+        arg, aux = _model.unpack_params(params)
+        return {**arg, **aux}
+    return dict(params)
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    """Reference: strips params absorbed into TRT engine nodes and
+    returns the remainder.  XLA absorbs nothing — every param stays a
+    bindable input — so the remainder is the full set (copied and
+    prefix-normalized, matching the reference's copy semantics)."""
+    return _normalize_params(arg_params), _normalize_params(aux_params)
+
+
+def tensorrt_bind(symbol, ctx=None, all_params=None, type_dict=None,
+                  grad_req="null", **kwargs):
+    """Bind ``symbol`` for optimized inference and load ``all_params``
+    into the executor (the historical mx.contrib.tensorrt.tensorrt_bind
+    contract: shapes for non-param inputs arrive as kwargs, e.g.
+    ``data=(32, 3, 224, 224)``)."""
+    all_params = _normalize_params(all_params or {})
+    arg_names = set(symbol.list_arguments())
+    aux_names = set(symbol.list_auxiliary_states())
+    arg_params = {k: v for k, v in all_params.items() if k in arg_names}
+    aux_params = {k: v for k, v in all_params.items() if k in aux_names}
+
+    if get_use_fp16():
+        from .. import amp
+        symbol, arg_params, aux_params = amp.convert_model(
+            symbol, arg_params, aux_params, target_dtype="bfloat16")
+
+    shape_kwargs = dict(kwargs)
+    for name, arr in arg_params.items():
+        shape_kwargs.setdefault(name, tuple(arr.shape))
+    ex = symbol.simple_bind(ctx=ctx, grad_req=grad_req,
+                            type_dict=type_dict, **shape_kwargs)
+    ex.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+    return ex

@@ -45,9 +45,12 @@ def test_tensorboard_callback_degrades_without_writer():
     assert cb.history["accuracy"] == [1.0, 1.0]
 
 
-def test_tensorrt_gate_redirects():
-    with pytest.raises(NotImplementedError, match="StableHLO"):
-        mx.contrib.tensorrt.tensorrt_bind()
+def test_tensorrt_bind_requires_symbol():
+    # tensorrt_bind is a real executor factory now
+    # (tests/test_contrib.py::test_tensorrt_bind_runs_optimized_inference);
+    # calling it without a symbol is an ordinary usage error
+    with pytest.raises(AttributeError):
+        mx.contrib.tensorrt.tensorrt_bind(None)
 
 
 def test_dataloader_iter_adapter():
