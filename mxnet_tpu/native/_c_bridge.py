@@ -24,6 +24,8 @@ __all__ = [
     "nd_zeros", "nd_from_bytes", "nd_shape", "nd_dtype_code", "nd_tobytes",
     "nd_save", "nd_load", "invoke", "sym_from_json", "sym_to_json",
     "sym_list_arguments", "sym_list_outputs", "wait_all",
+    "autograd_set_recording", "autograd_mark_variable",
+    "autograd_backward", "nd_get_grad", "list_ops",
 ]
 
 
@@ -103,3 +105,38 @@ def sym_list_outputs(sym):
 def wait_all():
     _nd.waitall()
     return 0
+
+
+# ------------------------------------------------------------- autograd
+# Reference surface: MXAutogradSetIsRecording / MXAutogradMarkVariables /
+# MXAutogradBackwardEx / MXNDArrayGetGrad (src/c_api/c_api_ndarray.cc:319)
+
+def autograd_set_recording(flag):
+    """Returns the previous recording state as 0/1."""
+    from .. import _tape
+    prev = _tape.set_recording(bool(flag))
+    return int(bool(prev))
+
+
+def autograd_mark_variable(h):
+    h.attach_grad()
+    return 0
+
+
+def autograd_backward(h):
+    h.backward()
+    return 0
+
+
+def nd_get_grad(h):
+    """A fresh handle on the accumulated gradient (zeros-shaped error if
+    the array was never marked)."""
+    if h.grad is None:
+        raise ValueError("array has no gradient buffer: call "
+                         "MXTpuAutogradMarkVariable first")
+    return h.grad
+
+
+def list_ops():
+    from ..ops import registry as _registry
+    return "\n".join(_registry.list_ops())

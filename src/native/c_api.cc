@@ -346,6 +346,63 @@ int MXTpuSymbolFree(void *h) {
   return 0;
 }
 
+// -------------------------------------------------------------- autograd
+// Reference: MXAutogradSetIsRecording / MXAutogradMarkVariables /
+// MXAutogradBackwardEx / MXNDArrayGetGrad (src/c_api/c_api_ndarray.cc:319).
+
+int MXTpuAutogradSetIsRecording(int flag, int *prev) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("autograd_set_recording",
+                              Py_BuildValue("(i)", flag));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// Allocate a gradient buffer and mark the array as a tape leaf.
+int MXTpuAutogradMarkVariable(void *h) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "autograd_mark_variable",
+      Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTpuAutogradBackward(void *loss) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "autograd_backward",
+      Py_BuildValue("(O)", static_cast<PyObject *>(loss)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// New reference to the accumulated gradient of a marked array.
+int MXTpuNDArrayGetGrad(void *h, void **out_grad) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "nd_get_grad", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  *out_grad = res;
+  return 0;
+}
+
+// Newline-joined registry op names (reference MXListAllOpNames).
+int MXTpuListOps(char *buf, long bufsize, long *needed) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("list_ops", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  int rc = str_out(res, buf, bufsize, needed);
+  Py_DECREF(res);
+  return rc;
+}
+
 // ------------------------------------------------------------------ misc
 
 // Reference MXNDArrayWaitAll: block until every queued computation is

@@ -262,3 +262,61 @@ def test_cpp_package_bindings(tmp_path):
     assert "add: 11.0 66.0" in r.stdout
     assert "loaded 2 arrays" in r.stdout
     assert "fcx_weight" in r.stdout
+
+
+def test_core_c_api_autograd_from_ctypes():
+    """The C autograd surface (MXTpuAutogradSetIsRecording/MarkVariable/
+    Backward/GetGrad — reference c_api_ndarray.cc:319): a host process
+    records y = x*x through the registry and reads dy/dx = 2x back."""
+    import ctypes
+    lib_path = os.path.join(ROOT, "mxnet_tpu", "native",
+                            "libmxtpu_c_api.so")
+    lib = ctypes.CDLL(lib_path)
+    lib.MXTpuCGetLastError.restype = ctypes.c_char_p
+
+    x = np.array([1.0, -2.0, 3.0], np.float32)
+    h = ctypes.c_void_p()
+    shp = (ctypes.c_long * 1)(3)
+    assert lib.MXTpuNDArrayCreateFromBytes(
+        x.ctypes.data_as(ctypes.c_void_p), ctypes.c_long(x.nbytes),
+        shp, 1, 0, ctypes.byref(h)) == 0
+
+    assert lib.MXTpuAutogradMarkVariable(h) == 0
+    prev = ctypes.c_int(-1)
+    assert lib.MXTpuAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert prev.value == 0
+
+    outs = (ctypes.c_void_p * 2)()
+    n_out = ctypes.c_int()
+    ins = (ctypes.c_void_p * 2)(h, h)
+    assert lib.MXTpuImperativeInvoke(b"elemwise_mul", 2, ins, 0, None,
+                                     None, 2, outs,
+                                     ctypes.byref(n_out)) == 0
+    y = ctypes.c_void_p(outs[0])
+    ins1 = (ctypes.c_void_p * 1)(y)
+    assert lib.MXTpuImperativeInvoke(b"sum", 1, ins1, 0, None, None, 2,
+                                     outs, ctypes.byref(n_out)) == 0
+    loss = ctypes.c_void_p(outs[0])
+    assert lib.MXTpuAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert prev.value == 1
+
+    assert lib.MXTpuAutogradBackward(loss) == 0, lib.MXTpuCGetLastError()
+    g = ctypes.c_void_p()
+    assert lib.MXTpuNDArrayGetGrad(h, ctypes.byref(g)) == 0
+    buf = np.empty_like(x)
+    nbytes = ctypes.c_long()
+    assert lib.MXTpuNDArrayGetData(g, buf.ctypes.data_as(ctypes.c_void_p),
+                                   ctypes.c_long(buf.nbytes),
+                                   ctypes.byref(nbytes)) == 0
+    np.testing.assert_allclose(buf, 2 * x)
+
+    # op enumeration (reference MXListAllOpNames)
+    need = ctypes.c_long()
+    assert lib.MXTpuListOps(None, 0, ctypes.byref(need)) == 0
+    sbuf = ctypes.create_string_buffer(need.value)
+    assert lib.MXTpuListOps(sbuf, need, ctypes.byref(need)) == 0
+    names = sbuf.value.decode().split("\n")
+    assert "FullyConnected" in names and len(names) > 500
+
+    for hh in (h, y, loss, g):
+        lib.MXTpuNDArrayFree(hh)
