@@ -236,12 +236,43 @@ class Symbol:
                 raise ValueError(
                     "simple_bind could not infer a shape for aux %r" % (name,))
             aux[name] = _wrap(jnp.zeros(shp, _np.float32))
+        placement = self._ctx_group_map(group2ctx)
+        self._place_groups(args, placement)
+        self._place_groups(aux, placement)
         args_grad = None
         if grad_req != "null":
+            # grads live beside the params they update (reference: grad
+            # arrays share the arg's assigned context)
             args_grad = {n: _wrap(jnp.zeros_like(v._data))
                          for n, v in args.items()}
+            self._place_groups(args_grad, placement)
         return Executor(self, ctx or current_context(), args, args_grad,
-                        grad_req, aux)
+                        grad_req, aux, placement=placement)
+
+    def _ctx_group_map(self, group2ctx):
+        """{var_name: Context} from each variable's ctx_group annotation
+        (reference: AssignContext + group2ctx, graph_executor.cc:997)."""
+        if not group2ctx:
+            return {}
+        out = {}
+        for node in _topo(self):
+            if node.kind != "var":
+                continue
+            grp = node._attr_map.get("ctx_group")
+            if grp is not None and grp in group2ctx:
+                out[node.name] = group2ctx[grp]
+        return out
+
+    @staticmethod
+    def _place_groups(arrays, placement):
+        """device_put each named array onto its ctx-group device: params
+        RESIDE where the user assigned them (multi-chip memory
+        distribution); the Executor inserts the cross-device copies at
+        run time like the reference's AssignContext copy nodes."""
+        for n, ctx in placement.items():
+            if n in arrays:
+                arrays[n]._data = jax.device_put(arrays[n]._data,
+                                                 ctx.jax_device)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -251,18 +282,53 @@ class Symbol:
         names = self.list_arguments()
         if isinstance(args, (list, tuple)):
             args = dict(zip(names, args))
-        args = {n: (v if isinstance(v, NDArray) else _wrap(jnp.asarray(v)))
-                for n, v in (args or {}).items()}
+        args = dict(args or {})
         aux_names = self.list_auxiliary_states()
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(aux_names, aux_states))
+        aux_states = dict(aux_states or {})
+        user_owned = {n for pool in (args, aux_states)
+                      for n, v in pool.items() if isinstance(v, NDArray)}
+        args = {n: (v if isinstance(v, NDArray) else _wrap(jnp.asarray(v)))
+                for n, v in args.items()}
         aux_states = {n: (v if isinstance(v, NDArray)
                           else _wrap(jnp.asarray(v)))
-                      for n, v in (aux_states or {}).items()}
+                      for n, v in aux_states.items()}
         if isinstance(args_grad, (list, tuple)):
             args_grad = dict(zip(names, args_grad))
+        args_grad = dict(args_grad or {}) or None
+        if args_grad:
+            user_owned |= {n for n, v in args_grad.items()
+                           if isinstance(v, NDArray)}
+            args_grad = {n: (v if isinstance(v, NDArray)
+                             else _wrap(jnp.asarray(v)))
+                         for n, v in args_grad.items()}
+        placement = self._ctx_group_map(group2ctx)
+        # caller-owned NDArrays must already sit on their assigned device
+        # (the reference ERRORS on a ctx mismatch rather than silently
+        # relocating user data); arrays we wrapped fresh get placed
+        for n, c in placement.items():
+            for pool in (args, aux_states) + ((args_grad,) if args_grad
+                                              else ()):
+                v = pool.get(n)
+                if v is None:
+                    continue
+                try:
+                    want = c.jax_device
+                    dev = next(iter(v._data.devices()))
+                except Exception:  # noqa: BLE001 — uncommitted values
+                    continue
+                if dev == want:
+                    continue
+                if n in user_owned:
+                    raise ValueError(
+                        "bind: argument %r lives on %s but its ctx_group "
+                        "assigns %s — create it on the assigned device "
+                        "(reference AssignContext ctx-mismatch check)"
+                        % (n, dev, want))
+                v._data = jax.device_put(v._data, want)
         return Executor(self, ctx or current_context(), args, args_grad,
-                        grad_req, aux_states)
+                        grad_req, aux_states, placement=placement)
 
     def eval(self, ctx=None, **kwargs):
         """One-shot forward (reference: Symbol.eval)."""
@@ -745,7 +811,8 @@ class Executor:
     (training, shape-signature); XLA replaces the reference's memory planning
     + bulked engine ops (src/executor/graph_executor.cc:1016,1288)."""
 
-    def __init__(self, sym, ctx, args, args_grad, grad_req, aux):
+    def __init__(self, sym, ctx, args, args_grad, grad_req, aux,
+                 placement=None):
         self._symbol = sym
         self._ctx = ctx
         self.arg_dict = dict(args or {})
@@ -758,12 +825,66 @@ class Executor:
         self._fwd_cache = {}
         self._bwd_cache = {}
         self._monitor = None
+        # ctx-group model parallelism: {name: jax.Device} where the user
+        # pinned each param via group2ctx — the single source of truth the
+        # forward/backward transfers, grad write-back, and
+        # copy_params_from all honor
+        self._placement = {}
+        for n, c in (placement or {}).items():
+            try:
+                self._placement[n] = c.jax_device
+            except Exception:  # noqa: BLE001 — backendless contexts
+                pass
 
     # internals -----------------------------------------------------------
+    def _to_exec_device(self, env):
+        """Transfer any array pinned to ANOTHER device onto the executor's
+        device before it feeds one jitted program — the reference's
+        AssignContext cross-device copy nodes (graph_executor.cc:997).
+        Same-device arrays pass through untouched."""
+        if not self._placement:
+            return env
+        ctx = self._ctx if self._ctx is not None else current_context()
+        try:
+            exec_dev = ctx.jax_device
+        except Exception:  # noqa: BLE001 — backendless contexts
+            return env
+        for n, v in env.items():
+            try:
+                if isinstance(v, jax.Array) and \
+                        next(iter(v.devices())) != exec_dev:
+                    env[n] = jax.device_put(v, exec_dev)
+            except Exception:  # noqa: BLE001 — tracers/uncommitted values
+                pass
+        return env
+
+    def _repin(self, name, arr):
+        """Keep an array on its ctx-group device (grads and copied-in
+        params stay beside the params they belong to)."""
+        dev = self._placement.get(name)
+        return jax.device_put(arr, dev) if dev is not None else arr
+
     def _env(self):
         env = {n: v._data for n, v in self.arg_dict.items()}
         env.update({n: v._data for n, v in self.aux_dict.items()})
-        return env
+        return self._to_exec_device(env)
+
+    @property
+    def arg_arrays(self):
+        """Arg arrays in list_arguments order, None for unbound names —
+        the positional correspondence the reference Executor guarantees."""
+        return [self.arg_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict.get(n)
+                for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
 
     def _fwd_fn(self, training):
         from .. import config as _config
@@ -797,7 +918,8 @@ class Executor:
         outs, aux_updates = self._fwd_fn(bool(is_train))(self._env(), key)
         for n, v in aux_updates.items():
             if n in self.aux_dict:
-                self.aux_dict[n]._data = v
+                # pinned aux states (BN stats) stay on their ctx-group device
+                self.aux_dict[n]._data = self._repin(n, v)
         from ..ndarray.ndarray import _wrap as _w2
         self.outputs = [_w2(o) for o in outs]
         if self._monitor:
@@ -848,7 +970,9 @@ class Executor:
         rest_env = {n: v._data for n, v in self.aux_dict.items()}
         rest_env.update({n: v._data for n, v in self.arg_dict.items()
                          if n not in wrt})
-        wrt_vals = {n: self.arg_dict[n]._data for n in wrt}
+        rest_env = self._to_exec_device(rest_env)
+        wrt_vals = self._to_exec_device(
+            {n: self.arg_dict[n]._data for n in wrt})
         if out_grads is not None:
             if isinstance(out_grads, (NDArray, jnp.ndarray, _np.ndarray)):
                 out_grads = [out_grads]
@@ -861,11 +985,12 @@ class Executor:
             if g.dtype == jax.dtypes.float0:
                 continue
             req = self.grad_req.get(n, "write")
+            g = self._repin(n, g)  # grads live beside their params
             tgt = self.grad_dict.get(n)
             if tgt is None:
                 self.grad_dict[n] = _wrap(g)
             elif req == "add":
-                tgt._data = tgt._data + g
+                tgt._data = self._repin(n, tgt._data + g)
             else:
                 tgt._data = g
 
@@ -878,14 +1003,14 @@ class Executor:
         from ..ndarray.ndarray import NDArray
         for n, v in (arg_params or {}).items():
             if n in self.arg_dict:
-                self.arg_dict[n]._data = \
-                    v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                self.arg_dict[n]._data = self._repin(
+                    n, v._data if isinstance(v, NDArray) else jnp.asarray(v))
             elif not allow_extra_params:
                 raise ValueError("unknown argument %r" % (n,))
         for n, v in (aux_params or {}).items():
             if n in self.aux_dict:
-                self.aux_dict[n]._data = \
-                    v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                self.aux_dict[n]._data = self._repin(
+                    n, v._data if isinstance(v, NDArray) else jnp.asarray(v))
             elif not allow_extra_params:
                 raise ValueError("unknown aux state %r" % (n,))
 
@@ -895,13 +1020,16 @@ class Executor:
         new_args = {}
         for n, v in self.arg_dict.items():
             if n in kwargs:
-                new_args[n] = _wrap(jnp.zeros(tuple(kwargs[n]),
-                                              v._data.dtype))
+                # fresh arrays inherit the name's ctx-group placement
+                new_args[n] = _wrap(self._repin(
+                    n, jnp.zeros(tuple(kwargs[n]), v._data.dtype)))
             else:
                 new_args[n] = v
-        return Executor(self._symbol, self._ctx, new_args,
-                        dict(self.grad_dict), self.grad_req,
-                        dict(self.aux_dict))
+        ex = Executor(self._symbol, self._ctx, new_args,
+                      dict(self.grad_dict), self.grad_req,
+                      dict(self.aux_dict))
+        ex._placement = dict(self._placement)
+        return ex
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor = callback

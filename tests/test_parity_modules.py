@@ -182,3 +182,73 @@ def test_conv_internal_layout_nhwc_parity():
     finally:
         mx.config.set("conv.internal_layout", "native")
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-5)
+
+
+def test_ctx_group_multi_device_placement():
+    """group2ctx model parallelism (reference: tests/python/unittest/
+    test_multi_device_exec.py test_ctx_group): stage-annotated params are
+    PLACED on their assigned devices, forward still computes correctly
+    (the executor inserts the cross-device copies), and grads live beside
+    their params."""
+    import numpy as np
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    stage1 = set(act1.list_arguments())
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=4)
+        out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    group2ctx = {"stage1": mx.cpu(1), "stage2": mx.cpu(2)}
+    for grad_req in ("write", "null"):
+        ex = out.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                             grad_req=grad_req, data=(2, 8))
+        for arr, name in zip(ex.arg_arrays, out.list_arguments()):
+            if name == "data":
+                continue  # the batch input follows the caller
+            expect = group2ctx["stage1" if name in stage1 else "stage2"]
+            dev = next(iter(arr._data.devices()))
+            assert dev == expect.jax_device, (name, dev)
+        if grad_req == "write":
+            for g, name in zip(ex.grad_arrays, out.list_arguments()):
+                if name == "data" or g is None:
+                    continue
+                expect = group2ctx["stage1" if name in stage1 else "stage2"]
+                gdev = next(iter(g._data.devices()))
+                assert gdev == expect.jax_device, (name, gdev)
+
+    # training across the placement: copy_params_from keeps arrays on
+    # their assigned devices, fwd+bwd compute (cross-device copies
+    # inserted), and grads stay beside their params after backward
+    ex = out.simple_bind(mx.cpu(0), group2ctx=group2ctx, grad_req="write",
+                         data=(2, 8))
+    ex.copy_params_from(
+        {n: mx.nd.array(np.full(a.shape, 0.1, np.float32))
+         for n, a in ex.arg_dict.items() if n != "data"},
+        allow_extra_params=True)
+    for arr, name in zip(ex.arg_arrays, out.list_arguments()):
+        if name == "data":
+            continue
+        expect = group2ctx["stage1" if name in stage1 else "stage2"]
+        assert next(iter(arr._data.devices())) == expect.jax_device, name
+    res = ex.forward(is_train=True, data=mx.nd.ones((2, 8)),
+                     softmax_label=mx.nd.zeros((2,)))[0].asnumpy()
+    assert res.shape == (2, 4)
+    np.testing.assert_allclose(res.sum(axis=1), np.ones(2), rtol=1e-5)
+    ex.backward()
+    for g, name in zip(ex.grad_arrays, out.list_arguments()):
+        if g is None or name in ("data", "softmax_label"):
+            continue
+        expect = group2ctx["stage1" if name in stage1 else "stage2"]
+        assert next(iter(g._data.devices())) == expect.jax_device, name
+        assert float(np.abs(g.asnumpy()).sum()) >= 0  # materialized
+
+    # caller arrays on the WRONG device are refused (reference
+    # AssignContext ctx-mismatch check), not silently relocated
+    import pytest as _pytest
+    w_wrong = mx.nd.ones((16, 8))  # default device, stage1 wants cpu(1)
+    with _pytest.raises(ValueError, match="ctx_group"):
+        out.bind(mx.cpu(0), args={"data": mx.nd.ones((2, 8)),
+                                  "fc1_weight": w_wrong},
+                 group2ctx=group2ctx, grad_req="null")
