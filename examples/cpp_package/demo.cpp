@@ -55,6 +55,24 @@ int main(int argc, char **argv) {
       if (sym2.ListOutputs().empty()) return 1;
     }
 
+    /* autograd: d(sum(x*x))/dx = 2x, through the RAII record scope */
+    mxtpu::NDArray xa(lib, {1, -2, 3}, {3});
+    mxtpu::autograd::MarkVariable(xa);
+    std::vector<mxtpu::NDArray> loss;
+    {
+      mxtpu::autograd::RecordScope rec(lib);
+      auto sq = mxtpu::Op(lib, "elemwise_mul").Invoke({&xa, &xa});
+      loss = mxtpu::Op(lib, "sum").Invoke({&sq[0]});
+    }
+    mxtpu::autograd::Backward(loss[0]);
+    auto gv = mxtpu::autograd::GetGrad(xa).CopyTo();
+    std::printf("grad: %.1f %.1f %.1f\n", gv[0], gv[1], gv[2]);
+    if (gv != std::vector<float>({2.f, -4.f, 6.f})) return 1;
+
+    auto ops = mxtpu::ListOps(lib);
+    std::printf("ops: %zu\n", ops.size());
+    if (ops.size() < 500) return 1;
+
     mxtpu::WaitAll(lib);
     std::printf("CPP_PACKAGE_OK\n");
     return 0;

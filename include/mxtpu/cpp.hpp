@@ -55,6 +55,11 @@ class Lib {
   using symjson_fn = int (*)(const char *, void **);
   using symto_fn = int (*)(void *, char *, long, long *);
   using waitall_fn = int (*)();
+  using setrec_fn = int (*)(int, int *);
+  using mark_fn = int (*)(void *);
+  using bwd_fn = int (*)(void *);
+  using getgrad_fn = int (*)(void *, void **);
+  using listops_fn = int (*)(char *, long, long *);
 
   static std::shared_ptr<Lib> Load(const std::string &path) {
     auto lib = std::shared_ptr<Lib>(new Lib());
@@ -99,6 +104,11 @@ class Lib {
   symto_fn sym_list_outputs_ = nullptr;
   free_fn sym_free_ = nullptr;
   waitall_fn wait_all_ = nullptr;
+  setrec_fn autograd_set_recording_ = nullptr;
+  mark_fn autograd_mark_variable_ = nullptr;
+  bwd_fn autograd_backward_ = nullptr;
+  getgrad_fn nd_get_grad_ = nullptr;
+  listops_fn list_ops_ = nullptr;
 
  private:
   Lib() = default;
@@ -130,6 +140,11 @@ class Lib {
     Sym(&sym_list_outputs_, "MXTpuSymbolListOutputs");
     Sym(&sym_free_, "MXTpuSymbolFree");
     Sym(&wait_all_, "MXTpuWaitAll");
+    Sym(&autograd_set_recording_, "MXTpuAutogradSetIsRecording");
+    Sym(&autograd_mark_variable_, "MXTpuAutogradMarkVariable");
+    Sym(&autograd_backward_, "MXTpuAutogradBackward");
+    Sym(&nd_get_grad_, "MXTpuNDArrayGetGrad");
+    Sym(&list_ops_, "MXTpuListOps");
   }
 
   void *handle_ = nullptr;
@@ -266,6 +281,37 @@ class NDArray {
   void *handle_ = nullptr;
 };
 
+namespace detail {
+
+// Query/copy pattern shared by every string-out C function: first call
+// reports strlen+1 in *needed, second call copies.
+template <typename QueryFn>
+inline std::string QueryString(const LibPtr &lib, QueryFn fn) {
+  long needed = 0;
+  lib->Check(fn(nullptr, 0, &needed));
+  std::string out(static_cast<size_t>(needed), '\0');
+  lib->Check(fn(&out[0], needed, &needed));
+  out.resize(std::strlen(out.c_str()));
+  return out;
+}
+
+inline std::vector<std::string> SplitLines(const std::string &s) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t nl = s.find('\n', start);
+    if (nl == std::string::npos) {
+      if (start < s.size()) out.push_back(s.substr(start));
+      break;
+    }
+    out.push_back(s.substr(start, nl - start));
+    start = nl + 1;
+  }
+  return out;
+}
+
+}  // namespace detail
+
 // Imperative operator invocation (reference mxnet-cpp Operator chaining).
 class Op {
  public:
@@ -342,27 +388,15 @@ class Symbol {
       : lib_(std::move(lib)), handle_(handle) {}
 
   std::string StrCall(Lib::symto_fn fn) const {
-    long needed = 0;
-    lib_->Check(fn(handle_, nullptr, 0, &needed));
-    std::string out(static_cast<size_t>(needed), '\0');
-    lib_->Check(fn(handle_, &out[0], needed, &needed));
-    out.resize(std::strlen(out.c_str()));
-    return out;
+    void *h = handle_;
+    return detail::QueryString(
+        lib_, [fn, h](char *buf, long n, long *need) {
+          return fn(h, buf, n, need);
+        });
   }
 
   static std::vector<std::string> SplitLines(const std::string &s) {
-    std::vector<std::string> out;
-    size_t start = 0;
-    while (start <= s.size()) {
-      size_t nl = s.find('\n', start);
-      if (nl == std::string::npos) {
-        if (start < s.size()) out.push_back(s.substr(start));
-        break;
-      }
-      out.push_back(s.substr(start, nl - start));
-      start = nl + 1;
-    }
-    return out;
+    return detail::SplitLines(s);
   }
 
   LibPtr lib_;
@@ -370,6 +404,53 @@ class Symbol {
 };
 
 inline void WaitAll(const LibPtr &lib) { lib->Check(lib->wait_all_()); }
+
+// Autograd (reference mxnet-cpp Autograd usage over MXAutograd*):
+//   autograd::MarkVariable(x);
+//   { autograd::RecordScope rec(lib); y = ...; loss = ...; }
+//   autograd::Backward(loss);  auto g = autograd::GetGrad(x);
+namespace autograd {
+
+class RecordScope {
+ public:
+  explicit RecordScope(LibPtr lib) : lib_(std::move(lib)) {
+    lib_->Check(lib_->autograd_set_recording_(1, &prev_));
+  }
+  ~RecordScope() {
+    int ignored = 0;
+    lib_->autograd_set_recording_(prev_, &ignored);
+  }
+  RecordScope(const RecordScope &) = delete;
+  RecordScope &operator=(const RecordScope &) = delete;
+
+ private:
+  LibPtr lib_;
+  int prev_ = 0;
+};
+
+inline void MarkVariable(const NDArray &x) {
+  x.lib()->Check(x.lib()->autograd_mark_variable_(x.handle()));
+}
+
+inline void Backward(const NDArray &loss) {
+  loss.lib()->Check(loss.lib()->autograd_backward_(loss.handle()));
+}
+
+inline NDArray GetGrad(const NDArray &x) {
+  void *g = nullptr;
+  x.lib()->Check(x.lib()->nd_get_grad_(x.handle(), &g));
+  return NDArray(x.lib(), g);
+}
+
+}  // namespace autograd
+
+inline std::vector<std::string> ListOps(const LibPtr &lib) {
+  Lib::listops_fn fn = lib->list_ops_;
+  return detail::SplitLines(detail::QueryString(
+      lib, [fn](char *buf, long n, long *need) {
+        return fn(buf, n, need);
+      }));
+}
 
 }  // namespace mxtpu
 

@@ -262,6 +262,7 @@ def test_cpp_package_bindings(tmp_path):
     assert "add: 11.0 66.0" in r.stdout
     assert "loaded 2 arrays" in r.stdout
     assert "fcx_weight" in r.stdout
+    assert "grad: 2.0 -4.0 6.0" in r.stdout
 
 
 def test_core_c_api_autograd_from_ctypes():
