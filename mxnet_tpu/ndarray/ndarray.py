@@ -656,6 +656,17 @@ def save(fname, data):
 
 
 def load(fname):
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from ..compat import is_mxnet_params, load_mxnet_params
+    if is_mxnet_params(head):
+        # a REAL Apache-MXNet .params file (list magic 0x112): parse the
+        # reference wire format so existing checkpoints load as-is
+        with open(fname, "rb") as f:
+            raw = load_mxnet_params(f.read())
+        if isinstance(raw, list):  # anonymous list save returns a list
+            return [array(v) for v in raw]
+        return {n: array(v) for n, v in raw.items()}
     with _np.load(fname, allow_pickle=False) as zf:
         names = list(zf.keys())
         if names == ["__mx_single__"]:

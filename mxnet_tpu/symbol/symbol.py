@@ -377,6 +377,11 @@ def _json_attrs(attrs):
 
 
 def load_json(s):
+    from ..compat import is_mxnet_symbol_json, load_mxnet_symbol
+    if is_mxnet_symbol_json(s):
+        # a REAL Apache-MXNet symbol.json (NNVM graph schema): replay it
+        # through the native builders so existing models load as-is
+        return load_mxnet_symbol(s)
     data = json.loads(s)
     nodes = []
     for spec in data["nodes"]:

@@ -12,13 +12,15 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=600):
+def _run(script, *args, timeout=600, cwd=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # never run with cwd=repo-root: scripts export checkpoints into cwd
+    import tempfile
     r = subprocess.run([sys.executable, os.path.join(ROOT, script),
                         "--cpu", *args],
                        capture_output=True, text=True, timeout=timeout,
-                       cwd=ROOT, env=env)
+                       cwd=cwd or tempfile.mkdtemp(), env=env)
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
     return r.stdout
 
