@@ -120,32 +120,41 @@ def test_load_reference_symbol_json():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
-def test_load_checkpoint_from_reference_files(tmp_path):
-    """The full migration flow: mx.model.load_checkpoint on a
-    reference-format checkpoint pair -> Module inference."""
-    rng = np.random.RandomState(2)
+def _write_reference_checkpoint(tmp_path, epoch, seed):
+    """Write the reference-format MLP checkpoint pair; returns
+    (prefix, (w1, b1, w2), fwd) with fwd the numpy reference model."""
+    rng = np.random.RandomState(seed)
     w1 = rng.normal(size=(3, 4)).astype(np.float32)
     b1 = rng.normal(size=(3,)).astype(np.float32)
     w2 = rng.normal(size=(2, 3)).astype(np.float32)
     prefix = str(tmp_path / "legacy")
     with open(prefix + "-symbol.json", "w") as f:
         f.write(_reference_mlp_json())
-    with open(prefix + "-0003.params", "wb") as f:
+    with open(prefix + "-%04d.params" % epoch, "wb") as f:
         f.write(_pack_params([("arg:fc1_weight", w1), ("arg:fc1_bias", b1),
                               ("arg:fc2_weight", w2)]))
 
+    def fwd(x):
+        return np.maximum(x @ w1.T + b1, 0) @ w2.T
+
+    return prefix, (w1, b1, w2), fwd
+
+
+def test_load_checkpoint_from_reference_files(tmp_path):
+    """The full migration flow: mx.model.load_checkpoint on a
+    reference-format checkpoint pair -> Module inference."""
+    prefix, _, fwd = _write_reference_checkpoint(tmp_path, epoch=3, seed=2)
     sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
     assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight"}
     assert aux == {}
     mod = mx.mod.Module(sym, data_names=["data"], label_names=[])
     mod.bind(data_shapes=[("data", (5, 4))], for_training=False)
     mod.set_params(arg, aux)
-    x = rng.normal(size=(5, 4)).astype(np.float32)
+    x = np.random.RandomState(9).normal(size=(5, 4)).astype(np.float32)
     from mxnet_tpu.io import DataBatch
     mod.forward(DataBatch([mx.nd.array(x)], None), is_train=False)
     out = mod.get_outputs()[0].asnumpy()
-    ref = np.maximum(x @ w1.T + b1, 0) @ w2.T
-    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out, fwd(x), rtol=1e-5, atol=1e-6)
 
 
 def test_multi_output_reference_graph():
@@ -237,3 +246,16 @@ def test_v3_zero_d_scalar_and_none_arrays(tmp_path):
     assert float(loaded[0].asnumpy()) == 7.5
     np.testing.assert_array_equal(loaded[1].asnumpy(),
                                   np.arange(3, dtype=np.float32))
+
+
+def test_symbolblock_imports_reference_checkpoint(tmp_path):
+    """gluon.SymbolBlock.imports on a reference-format checkpoint pair:
+    the legacy sniffers make the standard deployment flow work unchanged
+    (reference block.py:1223 SymbolBlock.imports)."""
+    from mxnet_tpu import gluon
+    prefix, _, fwd = _write_reference_checkpoint(tmp_path, epoch=0, seed=3)
+    net = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                    prefix + "-0000.params")
+    x = np.random.RandomState(8).normal(size=(5, 4)).astype(np.float32)
+    out = net(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, fwd(x), rtol=1e-5, atol=1e-6)
