@@ -61,6 +61,8 @@ _LAZY = {
     "contrib": ".contrib",
     "deploy": ".deploy",
     "config": ".config",
+    "compat": ".compat",
+    "dlpack": ".dlpack",
     "library": ".library",
     "rtc": ".rtc",
     "attribute": ".attribute",

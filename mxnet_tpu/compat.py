@@ -31,7 +31,8 @@ import struct
 import numpy as _np
 
 __all__ = ["load_mxnet_params", "load_mxnet_symbol", "is_mxnet_params",
-           "is_mxnet_symbol_json", "MXNET_PARAMS_MAGIC"]
+           "is_mxnet_symbol_json", "save_mxnet_params",
+           "save_mxnet_symbol", "MXNET_PARAMS_MAGIC"]
 
 MXNET_PARAMS_MAGIC = 0x112
 _ND_V1 = 0xF993FAC8
@@ -146,6 +147,130 @@ def load_mxnet_params(data):
         raise ValueError("corrupt MXNet params file: %d names for %d "
                          "arrays" % (len(names), len(arrays)))
     return {k: v for k, v in zip(names, arrays) if v is not None}
+
+
+# ------------------------------------------------------------------ save
+
+_NP_TO_TYPE_FLAG = {_np.dtype(v): k for k, v in _TYPE_FLAG_TO_NP.items()}
+
+
+def save_mxnet_params(fname, data):
+    """Write arrays in the reference ``.params`` wire format (V2 records
+    inside the 0x112 list container) so the file loads in real Apache
+    MXNet.  ``data`` is a dict (names saved verbatim — use ``arg:``/
+    ``aux:`` prefixes for checkpoint pairs) or a list (anonymous save)."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[n] for n in names]
+    else:
+        names, arrays = [], list(data)
+
+    def host(a):
+        return a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+
+    out = [struct.pack("<QQQ", MXNET_PARAMS_MAGIC, 0, len(arrays))]
+    for a in arrays:
+        a = _np.asarray(host(a))
+        if a.ndim:  # ascontiguousarray would promote 0-d to 1-d
+            a = _np.ascontiguousarray(a)
+        flag = _NP_TO_TYPE_FLAG.get(a.dtype)
+        if flag is None:
+            raise NotImplementedError(
+                "MXNet params export: dtype %s has no reference type flag "
+                "(cast to float32/int32 first)" % a.dtype)
+        # a 0-d record must use the V3 (np-shape) layout: every older
+        # version reads ndim=0 as a none-array marker and stops
+        magic = _ND_V3 if a.ndim == 0 else _ND_V2
+        rec = struct.pack("<Ii", magic, 0)           # kDefaultStorage
+        rec += struct.pack("<i", a.ndim)
+        rec += struct.pack("<%dq" % a.ndim, *a.shape) if a.ndim else b""
+        rec += struct.pack("<iii", 1, 0, flag)       # cpu(0) ctx + dtype
+        rec += a.tobytes()
+        out.append(rec)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode()
+        out.append(struct.pack("<Q", len(b)) + b)
+    payload = b"".join(out)
+    if fname is None:
+        return payload
+    with open(fname, "wb") as f:
+        f.write(payload)
+    return fname
+
+
+def save_mxnet_symbol(sym):
+    """Serialize a Symbol into the reference's NNVM graph JSON schema
+    (nodes with string attrs, [id, idx, version] input triplets,
+    arg_nodes, heads) so real Apache MXNet can load it.  Only graphs made
+    of reference-named ops export — ops the reference lacks raise."""
+    from .symbol.symbol import (_topo, _unwrap_slice, _node_num_outputs,
+                                Symbol)
+
+    # annotation attrs real MXNet only reads in their dunder form
+    _ANNO = ("lr_mult", "wd_mult", "ctx_group", "force_mirroring",
+             "init", "shape", "dtype")
+
+    def dunder(k):
+        return "__%s__" % k if k in _ANNO and not k.startswith("__") else k
+
+    nodes = _topo(sym)
+    nid = {}
+    out_nodes = []
+    for n in nodes:
+        if n.kind == "slice":
+            # a slice node is an output selector, not a reference node:
+            # consumers reference [base_id, index]
+            nid[id(n)] = nid[id(n.inputs[0])]
+            continue
+        ins = []
+        for x in n.inputs:
+            if x is None:
+                continue  # a no_bias slot: the reference omits the input
+            if not isinstance(x, Symbol):
+                raise NotImplementedError(
+                    "MXNet symbol export: node %r captures a constant "
+                    "array; the NNVM schema has no constant inputs — "
+                    "bind it as a Variable instead" % n.name)
+            base, idx = _unwrap_slice(x)
+            ins.append([nid[id(base)], idx, 0])
+        nid[id(n)] = len(out_nodes)
+        entry = {"op": "null" if n.kind == "var" else n.op,
+                 "name": n.name, "inputs": ins}
+        if n.kind == "var":
+            # var attrs are Variable shape/dtype hints -> dunder
+            # annotations (real MXNet reads __shape__/__dtype__)
+            attrs = {dunder(k): str(v) for k, v in (n.attrs or {}).items()
+                     if v is not None}
+        else:
+            # op attrs are REQUIRED parameters (Reshape shape, Cast
+            # dtype, ...) and export verbatim as strings
+            attrs = {k: str(v) for k, v in (n.attrs or {}).items()
+                     if v is not None}
+        attrs.update({dunder(k): str(v) for k, v in n._attr_map.items()})
+        if attrs:
+            entry["attrs"] = attrs
+        out_nodes.append(entry)
+    heads = []
+    for h in sym._heads():
+        base, idx = _unwrap_slice(h)
+        n_out = _node_num_outputs(base)
+        if h.kind != "slice" and base.kind == "op" and n_out > 1:
+            # a bare multi-output head exposes EVERY output, matching
+            # list_outputs' expansion
+            heads.extend([nid[id(base)], i, 0] for i in range(n_out))
+        else:
+            heads.append([nid[id(base)], idx, 0])
+    arg_nodes = [i for i, e in enumerate(out_nodes) if e["op"] == "null"]
+    return json.dumps({
+        "nodes": out_nodes,
+        "arg_nodes": arg_nodes,
+        "node_row_ptr": list(range(len(out_nodes) + 1)),
+        "heads": heads,
+        "attrs": {"mxnet_version": ["int", 10600]},
+    }, indent=2)
 
 
 # ------------------------------------------------------------ symbol.json

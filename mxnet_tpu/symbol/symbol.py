@@ -201,8 +201,7 @@ class Symbol:
             if n > 1 and h.kind == "op" and self.kind != "group":
                 out_res.extend(out_shapes.get((id(h), i)) for i in range(n))
             else:
-                base = h.inputs[0] if h.kind == "slice" else h
-                idx = h.index if h.kind == "slice" else 0
+                base, idx = _unwrap_slice(h)
                 out_res.append(out_shapes.get((id(base), idx)))
         return arg_res, out_res, aux_res
 
@@ -615,8 +614,7 @@ def _infer_shapes_partial(sym, known, dtypes=None):
             if "shape" in x.attrs:
                 return tuple(x.attrs["shape"])
             return None
-        idx = x.index if x.kind == "slice" else 0
-        base = x.inputs[0] if x.kind == "slice" else x
+        base, idx = _unwrap_slice(x)
         return out_shapes.get((id(base), idx))
 
     for node in _topo(sym):
@@ -712,6 +710,14 @@ def _topo(sym):
 # BatchNorm's (mean, var) outputs exist in the graph but are hidden from the
 # user API, src/operator/nn/batch_norm.cc).
 _VISIBLE_OUTPUTS = {"BatchNorm": 1}
+
+
+def _unwrap_slice(x):
+    """(base_node, output_index) for a symbol that may be a slice
+    selector over a multi-output op."""
+    if x.kind == "slice":
+        return x.inputs[0], x.index
+    return x, 0
 
 
 def _node_num_outputs(node):
