@@ -140,3 +140,41 @@ def nd_get_grad(h):
 def list_ops():
     from ..ops import registry as _registry
     return "\n".join(_registry.list_ops())
+
+
+# ------------------------------------------------------------- executor
+# Reference surface: MXExecutorSimpleBindEx / MXExecutorForward /
+# MXExecutorOutputs (src/c_api/c_api_executor.cc:135,860)
+
+def executor_simple_bind(sym, names, shapes):
+    # the dict-based path: ANY input name works, even ones colliding
+    # with the kwargs API's own parameters (e.g. a Variable named "ctx")
+    shape_map = {n: tuple(int(d) for d in s)
+                 for n, s in zip(names, shapes)}
+    return sym._simple_bind_shapes(shape_map, grad_req="null")
+
+
+def executor_copy_params(ex, names, arrays):
+    """Returns the number of names that matched a bound param — a caller
+    whose every name missed (typos) sees 0 and can fail loudly."""
+    ex.copy_params_from(dict(zip(names, arrays)),
+                        allow_extra_params=True)
+    bound = set(ex.arg_dict) | set(ex.aux_dict)
+    return sum(1 for n in names if n in bound)
+
+
+def executor_forward(ex, names, arrays, is_train):
+    # feed inputs by direct arg assignment (no **kwargs, so names like
+    # "is_train" stay legal), then run
+    from ..ndarray.ndarray import _wrap
+    for n, v in zip(names, arrays):
+        if n in ex.arg_dict:
+            ex.arg_dict[n]._data = v._data
+        else:
+            ex.arg_dict[n] = _wrap(v._data)
+    ex.forward(is_train=bool(is_train))
+    return len(ex.outputs)
+
+
+def executor_output(ex, i):
+    return ex.outputs[int(i)]

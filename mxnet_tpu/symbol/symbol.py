@@ -219,7 +219,16 @@ class Symbol:
                     group2ctx=None, shared_exec=None, **kwargs):
         """Allocate arguments from shapes and bind (reference:
         MXExecutorSimpleBindEx, src/c_api/c_api_executor.cc:860)."""
-        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        return self._simple_bind_shapes(kwargs, ctx=ctx, grad_req=grad_req,
+                                        type_dict=type_dict,
+                                        group2ctx=group2ctx)
+
+    def _simple_bind_shapes(self, shape_map, ctx=None, grad_req="write",
+                            type_dict=None, group2ctx=None):
+        """Dict-based simple_bind: input names that collide with the
+        kwargs API's own parameters (a Variable literally named "ctx")
+        bind through here — the C ABI uses this path."""
+        arg_shapes, _, aux_shapes = self.infer_shape(**dict(shape_map))
         from ..ndarray.ndarray import _wrap
         args = {}
         for name, shp in zip(self.list_arguments(), arg_shapes):

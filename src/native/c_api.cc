@@ -403,6 +403,111 @@ int MXTpuListOps(char *buf, long bufsize, long *needed) {
   return rc;
 }
 
+// -------------------------------------------------------------- executor
+// Reference: MXExecutorSimpleBindEx / MXExecutorForward / MXExecutorOutputs
+// (src/c_api/c_api_executor.cc:135,860).  The handle is a refcounted
+// Executor; shapes arrive flat with a per-name ndim table.
+
+namespace {
+
+PyObject *names_shapes(int num, const char **names, const long *shapes,
+                       const int *ndims, PyObject **out_shapes) {
+  PyObject *pn = PyList_New(num);
+  PyObject *ps = PyList_New(num);
+  int off = 0;
+  for (int i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pn, i, PyUnicode_FromString(names[i]));
+    PyList_SET_ITEM(ps, i, shape_tuple(shapes + off, ndims[i]));
+    off += ndims[i];
+  }
+  *out_shapes = ps;
+  return pn;
+}
+
+PyObject *names_handles(int num, const char **names, void **nds,
+                        PyObject **out_handles) {
+  PyObject *pn = PyList_New(num);
+  PyObject *pa = PyList_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pn, i, PyUnicode_FromString(names[i]));
+    Py_INCREF(static_cast<PyObject *>(nds[i]));
+    PyList_SET_ITEM(pa, i, static_cast<PyObject *>(nds[i]));
+  }
+  *out_handles = pa;
+  return pn;
+}
+
+}  // namespace
+
+int MXTpuExecutorSimpleBind(void *sym, int num, const char **names,
+                            const long *shapes, const int *ndims,
+                            void **out_exec) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *ps = nullptr;
+  PyObject *pn = names_shapes(num, names, shapes, ndims, &ps);
+  PyObject *res = bridge_call(
+      "executor_simple_bind",
+      Py_BuildValue("(ONN)", static_cast<PyObject *>(sym), pn, ps));
+  if (res == nullptr) return -1;
+  *out_exec = res;
+  return 0;
+}
+
+// Load named params into the bound executor.  Extra names are ignored
+// (set_params allow_extra deploy semantics) but *num_matched reports how
+// many names actually hit a bound param, so an all-typos call is
+// detectable (0 matched) instead of silently running on zero weights.
+int MXTpuExecutorCopyParams(void *ex, int num, const char **names,
+                            void **nds, int *num_matched) {
+  Gil gil;
+  PyObject *pa = nullptr;
+  PyObject *pn = names_handles(num, names, nds, &pa);
+  PyObject *res = bridge_call(
+      "executor_copy_params",
+      Py_BuildValue("(ONN)", static_cast<PyObject *>(ex), pn, pa));
+  if (res == nullptr) return -1;
+  if (num_matched != nullptr) {
+    *num_matched = static_cast<int>(PyLong_AsLong(res));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTpuExecutorForward(void *ex, int num, const char **names, void **nds,
+                         int is_train, int *num_outputs) {
+  Gil gil;
+  PyObject *pa = nullptr;
+  PyObject *pn = names_handles(num, names, nds, &pa);
+  PyObject *res = bridge_call(
+      "executor_forward",
+      Py_BuildValue("(ONNi)", static_cast<PyObject *>(ex), pn, pa,
+                    is_train));
+  if (res == nullptr) return -1;
+  if (num_outputs != nullptr) {
+    *num_outputs = static_cast<int>(PyLong_AsLong(res));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// New NDArray reference to output i of the last forward.
+int MXTpuExecutorOutput(void *ex, int i, void **out_nd) {
+  Gil gil;
+  PyObject *res = bridge_call(
+      "executor_output",
+      Py_BuildValue("(Oi)", static_cast<PyObject *>(ex), i));
+  if (res == nullptr) return -1;
+  *out_nd = res;
+  return 0;
+}
+
+int MXTpuExecutorFree(void *ex) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(ex));
+  return 0;
+}
+
 // ------------------------------------------------------------------ misc
 
 // Reference MXNDArrayWaitAll: block until every queued computation is

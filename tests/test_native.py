@@ -321,3 +321,77 @@ def test_core_c_api_autograd_from_ctypes():
 
     for hh in (h, y, loss, g):
         lib.MXTpuNDArrayFree(hh)
+
+
+def test_core_c_api_executor_from_ctypes():
+    """The C executor surface (MXTpuExecutorSimpleBind/CopyParams/
+    Forward/Output — reference c_api_executor.cc:135,860): a host binds
+    an arbitrary symbol graph, loads params, and runs inference with
+    Python-parity values."""
+    import ctypes
+    lib = ctypes.CDLL(os.path.join(ROOT, "mxnet_tpu", "native",
+                                   "libmxtpu_c_api.so"))
+    lib.MXTpuCGetLastError.restype = ctypes.c_char_p
+
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                no_bias=True, name="fc")
+    js = sym.tojson().encode()
+    h_sym = ctypes.c_void_p()
+    assert lib.MXTpuSymbolCreateFromJSON(js, ctypes.byref(h_sym)) == 0
+
+    names = (ctypes.c_char_p * 1)(b"data")
+    shapes = (ctypes.c_long * 2)(2, 4)
+    ndims = (ctypes.c_int * 1)(2)
+    h_ex = ctypes.c_void_p()
+    rc = lib.MXTpuExecutorSimpleBind(h_sym, 1, names, shapes, ndims,
+                                     ctypes.byref(h_ex))
+    assert rc == 0, lib.MXTpuCGetLastError()
+
+    rng = np.random.RandomState(0)
+    w = rng.normal(size=(3, 4)).astype(np.float32)
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+
+    def nd_from(a):
+        h = ctypes.c_void_p()
+        shp = (ctypes.c_long * a.ndim)(*a.shape)
+        assert lib.MXTpuNDArrayCreateFromBytes(
+            a.ctypes.data_as(ctypes.c_void_p), ctypes.c_long(a.nbytes),
+            shp, a.ndim, 0, ctypes.byref(h)) == 0
+        return h
+
+    h_w = nd_from(w)
+    pnames = (ctypes.c_char_p * 1)(b"fc_weight")
+    pvals = (ctypes.c_void_p * 1)(h_w)
+    matched = ctypes.c_int(-1)
+    assert lib.MXTpuExecutorCopyParams(h_ex, 1, pnames, pvals,
+                                       ctypes.byref(matched)) == 0
+    assert matched.value == 1
+    # an all-typos call reports 0 matched instead of silently no-oping
+    bad = (ctypes.c_char_p * 1)(b"fc_weights")
+    assert lib.MXTpuExecutorCopyParams(h_ex, 1, bad, pvals,
+                                       ctypes.byref(matched)) == 0
+    assert matched.value == 0
+
+    h_x = nd_from(x)
+    inames = (ctypes.c_char_p * 1)(b"data")
+    ivals = (ctypes.c_void_p * 1)(h_x)
+    n_out = ctypes.c_int()
+    rc = lib.MXTpuExecutorForward(h_ex, 1, inames, ivals, 0,
+                                  ctypes.byref(n_out))
+    assert rc == 0, lib.MXTpuCGetLastError()
+    assert n_out.value == 1
+
+    h_out = ctypes.c_void_p()
+    assert lib.MXTpuExecutorOutput(h_ex, 0, ctypes.byref(h_out)) == 0
+    buf = np.empty((2, 3), np.float32)
+    nbytes = ctypes.c_long()
+    assert lib.MXTpuNDArrayGetData(h_out,
+                                   buf.ctypes.data_as(ctypes.c_void_p),
+                                   ctypes.c_long(buf.nbytes),
+                                   ctypes.byref(nbytes)) == 0
+    np.testing.assert_allclose(buf, x @ w.T, rtol=1e-5)
+
+    for h in (h_w, h_x, h_out):
+        lib.MXTpuNDArrayFree(h)
+    lib.MXTpuExecutorFree(h_ex)
+    lib.MXTpuSymbolFree(h_sym)
