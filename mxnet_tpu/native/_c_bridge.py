@@ -155,23 +155,21 @@ def executor_simple_bind(sym, names, shapes):
 
 
 def executor_copy_params(ex, names, arrays):
-    """Returns the number of names that matched a bound param — a caller
-    whose every name missed (typos) sees 0 and can fail loudly."""
-    ex.copy_params_from(dict(zip(names, arrays)),
-                        allow_extra_params=True)
+    """Returns the number of names that genuinely loaded into a bound
+    arg OR aux slot — a caller whose every name missed (typos) sees 0
+    and can fail loudly."""
+    d = dict(zip(names, arrays))
+    arg = {n: v for n, v in d.items() if n not in ex.aux_dict}
+    aux = {n: v for n, v in d.items() if n in ex.aux_dict}
+    ex.copy_params_from(arg, aux, allow_extra_params=True)
     bound = set(ex.arg_dict) | set(ex.aux_dict)
     return sum(1 for n in names if n in bound)
 
 
 def executor_forward(ex, names, arrays, is_train):
-    # feed inputs by direct arg assignment (no **kwargs, so names like
-    # "is_train" stay legal), then run
-    from ..ndarray.ndarray import _wrap
-    for n, v in zip(names, arrays):
-        if n in ex.arg_dict:
-            ex.arg_dict[n]._data = v._data
-        else:
-            ex.arg_dict[n] = _wrap(v._data)
+    # the collision-safe dict entry point (names like "is_train" stay
+    # legal) — same path Executor.forward's kwargs take
+    ex._feed_inputs(dict(zip(names, arrays)))
     ex.forward(is_train=bool(is_train))
     return len(ex.outputs)
 

@@ -925,15 +925,20 @@ class Executor:
         return self._fwd_cache[cache_key]
 
     # public --------------------------------------------------------------
-    def forward(self, is_train=False, **kwargs):
+    def _feed_inputs(self, input_map):
+        """Assign forward inputs by name from a dict — the collision-safe
+        entry point (names like "is_train" stay legal); forward()'s
+        kwargs and the C ABI bridge both route through here."""
         from ..ndarray.ndarray import NDArray, _wrap
-        for n, v in kwargs.items():
+        for n, v in input_map.items():
             arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             if n in self.arg_dict:
                 self.arg_dict[n]._data = arr
             else:
-                from ..ndarray.ndarray import _wrap as _w
-                self.arg_dict[n] = _w(arr)
+                self.arg_dict[n] = _wrap(arr)
+
+    def forward(self, is_train=False, **kwargs):
+        self._feed_inputs(kwargs)
         key = _random.new_eager_seed_key()
         outs, aux_updates = self._fwd_fn(bool(is_train))(self._env(), key)
         for n, v in aux_updates.items():

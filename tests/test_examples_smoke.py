@@ -47,3 +47,10 @@ def test_ssd_train_example():
     out = _run("examples/ssd_train.py", "--steps", "1", "--size", "128",
                timeout=900)
     assert "img/s" in out and "NMS" in out
+
+
+def test_benchmark_score_example():
+    out = _run("examples/benchmark_score.py", "--networks", "resnet18_v1",
+               "--batch-sizes", "2", "--iters", "2",
+               "--image-shape", "3,32,32", timeout=900)
+    assert "img/s" in out and "resnet18_v1" in out

@@ -395,3 +395,17 @@ def test_core_c_api_executor_from_ctypes():
         lib.MXTpuNDArrayFree(h)
     lib.MXTpuExecutorFree(h_ex)
     lib.MXTpuSymbolFree(h_sym)
+
+
+def test_c_bridge_copy_params_routes_aux_states():
+    """Aux-state names (BN moving stats) genuinely load — and only
+    genuinely loaded names count toward the matched total."""
+    from mxnet_tpu.native import _c_bridge as B
+    sym = mx.sym.BatchNorm(mx.sym.Variable("data"), name="bn")
+    ex = sym._simple_bind_shapes({"data": (2, 3)}, grad_req="null")
+    w = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    n = B.executor_copy_params(ex, ["bn_moving_mean", "not_a_param"],
+                               [w, w])
+    assert n == 1
+    np.testing.assert_array_equal(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                                  w.asnumpy())
