@@ -703,13 +703,15 @@ class HybridBlock(Block):
         return self._eager_forward(x, *args)
 
     def export(self, path, epoch=0, remove_amp_cast=True,
-               input_names=("data",)):
+               input_names=("data",), fmt="native"):
         """Export graph JSON + params for deployment
         (reference: block.py:1077) — see mxnet_tpu.symbol for the format.
-        Multi-input blocks name their inputs via ``input_names``."""
+        Multi-input blocks name their inputs via ``input_names``;
+        ``fmt="mxnet"`` writes the reference wire formats so the pair
+        deploys on real Apache-MXNet infrastructure."""
         from ..symbol import _export_hybrid_block
         return _export_hybrid_block(self, path, epoch,
-                                    input_names=input_names)
+                                    input_names=input_names, fmt=fmt)
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """Partial parity: on TPU the backend compiler is always XLA; this

@@ -19,19 +19,38 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 from ..ops import registry as _registry
 
 
-def _export_hybrid_block(block, path, epoch=0, input_names=("data",)):
+def _export_hybrid_block(block, path, epoch=0, input_names=("data",),
+                         fmt="native"):
     """HybridBlock.export backend: trace the block into a Symbol graph and
-    write the reference deployment pair ``path-symbol.json`` +
+    write the deployment pair ``path-symbol.json`` +
     ``path-%04d.params`` (arg:/aux: packing, python/mxnet/gluon/block.py:1077
-    + model.py:394) — reloadable with ``SymbolBlock.imports``."""
-    from .. import model as _model
+    + model.py:394) — reloadable with ``SymbolBlock.imports``.
+
+    ``fmt="mxnet"`` writes the REFERENCE wire formats instead (NNVM graph
+    JSON + binary .params via mxnet_tpu.compat), so the pair deploys on
+    real Apache-MXNet infrastructure."""
     out = block(*[Variable(n) for n in input_names])
     if isinstance(out, (list, tuple)):
         out = Group(list(out))
     arg, aux = {}, {}
     for name, p in block.collect_params().items():
         (aux if p.grad_req == "null" else arg)[name] = p.data()
-    _model.save_checkpoint(path, epoch, out, arg, aux)
+    from .. import model as _model
+    if fmt == "mxnet":
+        from .. import compat as _compat
+        # serialize BEFORE truncating: the mxnet exporter raises on ops
+        # the reference lacks, and a half-export must not destroy a
+        # previous good symbol.json
+        js = _compat.save_mxnet_symbol(out)
+        with open("%s-symbol.json" % path, "w") as f:
+            f.write(js)
+        _compat.save_mxnet_params("%s-%04d.params" % (path, epoch),
+                                  _model.pack_params(arg, aux))
+    elif fmt == "native":
+        _model.save_checkpoint(path, epoch, out, arg, aux)
+    else:
+        raise ValueError("export: unknown fmt %r (use 'native' or "
+                         "'mxnet')" % (fmt,))
     return ["%s-symbol.json" % path, "%s-%04d.params" % (path, epoch)]
 
 

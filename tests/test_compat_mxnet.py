@@ -356,3 +356,28 @@ def test_save_mxnet_symbol_bare_multi_output_head():
     assert [h[1] for h in g["heads"]] == [0, 1, 2]
     sym2 = mx.sym.load_json(compat.save_mxnet_symbol(parts))
     assert len(sym2.list_outputs()) == 3
+
+
+def test_export_fmt_mxnet_roundtrip(tmp_path):
+    """net.export(prefix, fmt="mxnet") writes the reference wire formats
+    directly and SymbolBlock.imports reloads the pair with identical
+    values."""
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(5, in_units=3), gluon.nn.Activation("relu"),
+            gluon.nn.BatchNorm(), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(6).normal(size=(4, 3)).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "m")
+    files = net.export(prefix, fmt="mxnet")
+    # the params file is genuinely the reference binary format
+    with open(files[1], "rb") as f:
+        head = f.read(8)
+    from mxnet_tpu.compat import is_mxnet_params
+    assert is_mxnet_params(head)
+    g = json.loads(open(files[0]).read())
+    assert "arg_nodes" in g  # NNVM schema, not the native one
+    net2 = gluon.SymbolBlock.imports(files[0], ["data"], files[1])
+    out = net2(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
