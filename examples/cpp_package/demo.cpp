@@ -53,6 +53,18 @@ int main(int argc, char **argv) {
       std::printf("\n");
       auto sym2 = mxtpu::Symbol::FromJSON(lib, sym.ToJSON());
       if (sym2.ListOutputs().empty()) return 1;
+      /* bind + run the loaded graph end to end */
+      auto ex = mxtpu::Executor::SimpleBind(sym, {{"data", {2, 3}}});
+      mxtpu::NDArray xw(lib, {1, 0, 0, 0, 1, 0, 0, 0, 1, 1, 1, 1}, {4, 3});
+      int matched = ex.CopyParams({{"fcx_weight", &xw}});
+      std::printf("matched params: %d\n", matched);
+      if (matched != 1) return 1;
+      mxtpu::NDArray xin(lib, {1, 2, 3, 4, 5, 6}, {2, 3});
+      auto outs = ex.Forward({{"data", &xin}});
+      auto v = outs[0].CopyTo();
+      std::printf("exec out: %.0f %.0f %.0f %.0f\n", v[0], v[1], v[2],
+                  v[3]);
+      if (v[0] != 1.f || v[3] != 6.f) return 1;
     }
 
     /* autograd: d(sum(x*x))/dx = 2x, through the RAII record scope */

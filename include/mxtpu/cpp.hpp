@@ -60,6 +60,12 @@ class Lib {
   using bwd_fn = int (*)(void *);
   using getgrad_fn = int (*)(void *, void **);
   using listops_fn = int (*)(char *, long, long *);
+  using exbind_fn = int (*)(void *, int, const char **, const long *,
+                            const int *, void **);
+  using excopy_fn = int (*)(void *, int, const char **, void **, int *);
+  using exfwd_fn = int (*)(void *, int, const char **, void **, int,
+                           int *);
+  using exout_fn = int (*)(void *, int, void **);
 
   static std::shared_ptr<Lib> Load(const std::string &path) {
     auto lib = std::shared_ptr<Lib>(new Lib());
@@ -109,6 +115,11 @@ class Lib {
   bwd_fn autograd_backward_ = nullptr;
   getgrad_fn nd_get_grad_ = nullptr;
   listops_fn list_ops_ = nullptr;
+  exbind_fn executor_simple_bind_ = nullptr;
+  excopy_fn executor_copy_params_ = nullptr;
+  exfwd_fn executor_forward_ = nullptr;
+  exout_fn executor_output_ = nullptr;
+  free_fn executor_free_ = nullptr;
 
  private:
   Lib() = default;
@@ -145,6 +156,11 @@ class Lib {
     Sym(&autograd_backward_, "MXTpuAutogradBackward");
     Sym(&nd_get_grad_, "MXTpuNDArrayGetGrad");
     Sym(&list_ops_, "MXTpuListOps");
+    Sym(&executor_simple_bind_, "MXTpuExecutorSimpleBind");
+    Sym(&executor_copy_params_, "MXTpuExecutorCopyParams");
+    Sym(&executor_forward_, "MXTpuExecutorForward");
+    Sym(&executor_output_, "MXTpuExecutorOutput");
+    Sym(&executor_free_, "MXTpuExecutorFree");
   }
 
   void *handle_ = nullptr;
@@ -163,6 +179,16 @@ enum class DType : int {
   kInt64 = 6,
   kBfloat16 = 12,
 };
+
+class NDArray;
+
+namespace detail {
+// Pack (name, NDArray*) pairs into the parallel C arrays every
+// names+handles entry point takes (defined after NDArray below).
+inline void PackPairs(
+    const std::vector<std::pair<std::string, NDArray *>> &items,
+    std::vector<const char *> *names, std::vector<void *> *handles);
+}  // namespace detail
 
 class NDArray {
  public:
@@ -244,10 +270,7 @@ class NDArray {
                    const std::vector<std::pair<std::string, NDArray *>> &items) {
     std::vector<void *> handles;
     std::vector<const char *> names;
-    for (const auto &kv : items) {
-      names.push_back(kv.first.c_str());
-      handles.push_back(kv.second->handle());
-    }
+    detail::PackPairs(items, &names, &handles);
     lib->Check(lib->nd_save_(fname.c_str(),
                              static_cast<int>(items.size()),
                              handles.data(), names.data()));
@@ -308,6 +331,19 @@ inline std::vector<std::string> SplitLines(const std::string &s) {
     start = nl + 1;
   }
   return out;
+}
+
+}  // namespace detail
+
+namespace detail {
+
+inline void PackPairs(
+    const std::vector<std::pair<std::string, NDArray *>> &items,
+    std::vector<const char *> *names, std::vector<void *> *handles) {
+  for (const auto &kv : items) {
+    names->push_back(kv.first.c_str());
+    handles->push_back(kv.second->handle());
+  }
 }
 
 }  // namespace detail
@@ -383,6 +419,9 @@ class Symbol {
     return SplitLines(StrCall(lib_->sym_list_outputs_));
   }
 
+  void *handle() const { return handle_; }
+  const LibPtr &lib() const { return lib_; }
+
  private:
   Symbol(LibPtr lib, void *handle)
       : lib_(std::move(lib)), handle_(handle) {}
@@ -398,6 +437,78 @@ class Symbol {
   static std::vector<std::string> SplitLines(const std::string &s) {
     return detail::SplitLines(s);
   }
+
+  LibPtr lib_;
+  void *handle_ = nullptr;
+};
+
+// Bound inference executor (reference mxnet-cpp Executor over
+// MXExecutorSimpleBindEx/Forward/Outputs).
+class Executor {
+ public:
+  static Executor SimpleBind(
+      const Symbol &sym,
+      const std::vector<std::pair<std::string, std::vector<long>>> &shapes) {
+    std::vector<const char *> names;
+    std::vector<long> flat;
+    std::vector<int> ndims;
+    for (const auto &kv : shapes) {
+      names.push_back(kv.first.c_str());
+      ndims.push_back(static_cast<int>(kv.second.size()));
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+    }
+    void *h = nullptr;
+    sym.lib()->Check(sym.lib()->executor_simple_bind_(
+        sym.handle(), static_cast<int>(shapes.size()), names.data(),
+        flat.data(), ndims.data(), &h));
+    return Executor(sym.lib(), h);
+  }
+
+  Executor(Executor &&o) noexcept : lib_(std::move(o.lib_)),
+                                    handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+  ~Executor() {
+    if (handle_ != nullptr && lib_ != nullptr) lib_->executor_free_(handle_);
+  }
+
+  // Returns how many names genuinely loaded into a bound arg/aux slot.
+  int CopyParams(
+      const std::vector<std::pair<std::string, NDArray *>> &params) {
+    std::vector<const char *> names;
+    std::vector<void *> nds;
+    detail::PackPairs(params, &names, &nds);
+    int matched = 0;
+    lib_->Check(lib_->executor_copy_params_(
+        handle_, static_cast<int>(params.size()), names.data(), nds.data(),
+        &matched));
+    return matched;
+  }
+
+  std::vector<NDArray> Forward(
+      const std::vector<std::pair<std::string, NDArray *>> &inputs,
+      bool is_train = false) {
+    std::vector<const char *> names;
+    std::vector<void *> nds;
+    detail::PackPairs(inputs, &names, &nds);
+    int num_out = 0;
+    lib_->Check(lib_->executor_forward_(
+        handle_, static_cast<int>(inputs.size()), names.data(), nds.data(),
+        is_train ? 1 : 0, &num_out));
+    std::vector<NDArray> outs;
+    for (int i = 0; i < num_out; ++i) {
+      void *h = nullptr;
+      lib_->Check(lib_->executor_output_(handle_, i, &h));
+      outs.emplace_back(lib_, h);
+    }
+    return outs;
+  }
+
+ private:
+  Executor(LibPtr lib, void *handle)
+      : lib_(std::move(lib)), handle_(handle) {}
 
   LibPtr lib_;
   void *handle_ = nullptr;
