@@ -53,7 +53,13 @@ int main(int argc, char **argv) {
       std::printf("\n");
       auto sym2 = mxtpu::Symbol::FromJSON(lib, sym.ToJSON());
       if (sym2.ListOutputs().empty()) return 1;
-      /* bind + run the loaded graph end to end */
+      /* bind + run end to end — only for the harness's known FC graph;
+         arbitrary symbol files still just roundtrip above */
+      bool is_harness_fc = false;
+      for (const auto &a : sym.ListArguments()) {
+        if (a == "fcx_weight") is_harness_fc = true;
+      }
+      if (is_harness_fc) {
       auto ex = mxtpu::Executor::SimpleBind(sym, {{"data", {2, 3}}});
       mxtpu::NDArray xw(lib, {1, 0, 0, 0, 1, 0, 0, 0, 1, 1, 1, 1}, {4, 3});
       int matched = ex.CopyParams({{"fcx_weight", &xw}});
@@ -65,6 +71,7 @@ int main(int argc, char **argv) {
       std::printf("exec out: %.0f %.0f %.0f %.0f\n", v[0], v[1], v[2],
                   v[3]);
       if (v[0] != 1.f || v[3] != 6.f) return 1;
+      }
     }
 
     /* autograd: d(sum(x*x))/dx = 2x, through the RAII record scope */

@@ -333,10 +333,6 @@ inline std::vector<std::string> SplitLines(const std::string &s) {
   return out;
 }
 
-}  // namespace detail
-
-namespace detail {
-
 inline void PackPairs(
     const std::vector<std::pair<std::string, NDArray *>> &items,
     std::vector<const char *> *names, std::vector<void *> *handles) {
