@@ -9,8 +9,11 @@ in one `jax.device_put` per batch (a single HBM DMA — the analog of the
 reference's pinned-memory copy).  Parallelism uses a thread pool with a
 bounded prefetch queue: augmentation is numpy (releases the GIL), and the
 double-buffering mirrors the reference's PrefetcherIter
-(src/io/iter_prefetcher.h:66).  A process pool can be enabled with
-``thread_pool=False`` for CPU-bound Python transforms.
+(src/io/iter_prefetcher.h:66).  A process pool (``thread_pool=False``)
+serves CPU-bound Python transforms: workers START via spawn by default
+(``dataloader.start_method`` knob; fork is opt-in — forking a live
+multithreaded XLA runtime risks deadlock), are pinned to the CPU backend,
+and hand batches back through POSIX shared memory.
 """
 from __future__ import annotations
 
