@@ -43,3 +43,14 @@ def _seed_rng():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end tests (several minutes)")
+
+
+def write_convergence_log(record):
+    """Append one record to the committed convergence artifact when
+    MXTPU_WRITE_CONVERGENCE_LOG is set (shared by the train-suite gates)."""
+    import json
+    import os
+    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
+    if out:
+        with open(out, "a") as f:
+            f.write(json.dumps(record) + "\n")

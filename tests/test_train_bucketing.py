@@ -9,7 +9,6 @@ with occasional noise), so a small recurrent LM drives perplexity toward
 named weights.
 """
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 
@@ -132,11 +131,7 @@ def test_bucketing_lm_converges():
     arg, _ = mod.get_params()
     assert "embed_weight" in arg and "hh_weight" in arg
 
-    import json
-    import os
-    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
-    if out:
-        with open(out, "a") as f:
-            f.write(json.dumps({"model": "bucketing_rnn_lm",
-                                "val_ppl_start": round(ppl0, 2),
-                                "val_ppl_final": round(ppl, 3)}) + "\n")
+    from tests.conftest import write_convergence_log
+    write_convergence_log({"model": "bucketing_rnn_lm",
+                           "val_ppl_start": round(ppl0, 2),
+                           "val_ppl_final": round(ppl, 3)})

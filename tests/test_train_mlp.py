@@ -131,10 +131,8 @@ def test_train_mlp_converges(mnist_dir, tmp_path):
     fmod.forward(batch, is_train=False)
     assert fmod.get_outputs()[0].shape == (100, 64)
 
-    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
-    if out:
-        with open(out, "a") as f:
-            f.write(json.dumps(log) + "\n")
+    from tests.conftest import write_convergence_log
+    write_convergence_log(log)
 
 
 def test_train_lenet_converges(mnist_dir):
@@ -172,11 +170,9 @@ def test_train_lenet_converges(mnist_dir):
     acc = correct / total
     assert acc > 0.95, "LeNet did not converge: val acc %.3f" % acc
 
-    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
-    if out:
-        with open(out, "a") as f:
-            f.write(json.dumps({"model": "lenet_gluon",
-                                "final_val_acc": round(acc, 4)}) + "\n")
+    from tests.conftest import write_convergence_log
+    write_convergence_log({"model": "lenet_gluon",
+                           "final_val_acc": round(acc, 4)})
 
 
 def test_train_bf16_mixed_precision_converges(mnist_dir):
@@ -215,8 +211,6 @@ def test_train_bf16_mixed_precision_converges(mnist_dir):
     acc = correct / total
     assert acc > 0.93, "bf16 training did not converge: val acc %.3f" % acc
 
-    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
-    if out:
-        with open(out, "a") as f:
-            f.write(json.dumps({"model": "lenet_bf16_spmd",
-                                "final_val_acc": round(acc, 4)}) + "\n")
+    from tests.conftest import write_convergence_log
+    write_convergence_log({"model": "lenet_bf16_spmd",
+                           "final_val_acc": round(acc, 4)})
