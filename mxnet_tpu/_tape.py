@@ -154,6 +154,7 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
     all backward math is itself recorded on the tape (see
     ``_recorded_node_backward``); otherwise they are raw jax arrays.
     """
+    import jax
     import jax.numpy as jnp
     from .ndarray.ndarray import _wrap
 
@@ -168,11 +169,29 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
     cotan = {}
     leaf_by_id = {}
 
+    def _to_device_of(anchor, val):
+        """Cross-device cotangent accumulation: shards computed on other
+        devices (gluon split_and_load emulation over virtual cpus) meet
+        here — insert the transfer the reference's per-ctx grad buffers +
+        kvstore reduce performed (comm.h:451); same-device is a no-op."""
+        try:
+            a = anchor._data if hasattr(anchor, "_data") else anchor
+            v = val._data if hasattr(val, "_data") else val
+            if isinstance(a, jax.Array) and isinstance(v, jax.Array):
+                ad, vd = a.devices(), v.devices()
+                if ad != vd:
+                    moved = jax.device_put(v, next(iter(ad)))
+                    return _wrap(moved) if hasattr(val, "_data") else moved
+        except Exception:  # noqa: BLE001 — tracers/uncommitted values
+            pass
+        return val
+
     def _acc(key, val):
         if val is None:
             return
         if key in cotan:
             prev = cotan[key]
+            val = _to_device_of(prev, val)
             from .ndarray.sparse import RowSparseTangent
             if isinstance(prev, RowSparseTangent) or \
                     isinstance(val, RowSparseTangent):
@@ -216,6 +235,13 @@ def _reverse_walk(outputs, head_grads, retain_graph, create_graph):
         from .ndarray.sparse import RowSparseTangent
         filled = []
         for arr, c in zip(node.outputs, out_cts):
+            if c is not None and not isinstance(c, RowSparseTangent):
+                # a vjp closure's residuals live on the node's OUTPUT
+                # device; a cotangent accumulated on another (virtual)
+                # device must transfer before the closure runs, or any
+                # order of backward (incl. create_graph re-tapes) mixes
+                # committed devices inside one jitted computation
+                c = _to_device_of(arr, c)
             if c is None:
                 z = jnp.zeros(arr.shape, arr._data.dtype)
                 filled.append(_wrap(z) if create_graph else z)
@@ -289,6 +315,17 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
                     arr._grad._set_rows(*_dedupe_rows(g.indices, g.values))
                 continue
             g = g.densify()
+        # grads land on the LEAF's device: a cotangent computed on another
+        # (virtual) device would otherwise poison the optimizer's eager
+        # update with a mixed-device op
+        try:
+            import jax as _jax
+            if isinstance(g, _jax.Array) and \
+                    isinstance(arr._data, _jax.Array) and \
+                    g.devices() != arr._data.devices():
+                g = _jax.device_put(g, next(iter(arr._data.devices())))
+        except Exception:  # noqa: BLE001 — uncommitted values
+            pass
         if arr._grad_req == "add":
             arr._grad._data = jnp.add(arr._grad._data, g)
         else:

@@ -246,6 +246,12 @@ class NDArray:
     # ------------------------------------------------------------- placement
     def copyto(self, other):
         if isinstance(other, Context):
+            if _tape.is_recording():
+                # a transfer inside record() must stay differentiable —
+                # the AssignContext CopyTo-node analog
+                from ..ops.registry import invoke
+                return invoke("_copy_to_device", self,
+                              _device=other.jax_device)
             return _wrap(jax.device_put(self._data, other.jax_device))
         if isinstance(other, NDArray):
             other._check_mutable()

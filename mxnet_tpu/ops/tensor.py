@@ -722,3 +722,12 @@ def _linspace_op(start=0, stop=None, num=50, endpoint=True, dtype="float32",
 def _eye_op(N=0, M=0, k=0, dtype="float32", **_):
     return jnp.eye(int(N), int(M) if M else None, k=int(k),
                    dtype=jnp.dtype(dtype))
+
+
+@register("_copy_to_device")
+def _copy_to_device(a, _device=None, **_):
+    """Differentiable cross-device copy (reference: the CopyTo op
+    AssignContext inserts between ctx groups): jax.device_put is a
+    primitive whose transpose returns the cotangent to the source device,
+    so NDArray.copyto(ctx) stays on the tape during record()."""
+    return jax.device_put(jnp.asarray(a), _device)

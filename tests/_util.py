@@ -1,0 +1,14 @@
+"""Side-effect-free helpers shared by test modules (importing conftest
+directly would re-execute its env/jax.config side effects as a second
+module object)."""
+
+
+def write_convergence_log(record):
+    """Append one record to the committed convergence artifact when
+    MXTPU_WRITE_CONVERGENCE_LOG is set (shared by the train-suite gates)."""
+    import json
+    import os
+    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
+    if out:
+        with open(out, "a") as f:
+            f.write(json.dumps(record) + "\n")

@@ -45,12 +45,5 @@ def pytest_configure(config):
         "markers", "slow: long-running end-to-end tests (several minutes)")
 
 
-def write_convergence_log(record):
-    """Append one record to the committed convergence artifact when
-    MXTPU_WRITE_CONVERGENCE_LOG is set (shared by the train-suite gates)."""
-    import json
-    import os
-    out = os.environ.get("MXTPU_WRITE_CONVERGENCE_LOG")
-    if out:
-        with open(out, "a") as f:
-            f.write(json.dumps(record) + "\n")
+# write_convergence_log lives in tests/_util.py: importing conftest from a
+# test module would re-execute this file's env side effects

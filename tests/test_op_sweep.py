@@ -951,6 +951,9 @@ spec("_contrib_Proposal",
 
 EXEMPT = {
     # name -> reason a forward sweep invocation is impossible/meaningless
+    "_copy_to_device": "requires a jax.Device attr; covered by "
+                       "tests/test_train_autograd.py's cross-device "
+                       "training gate",
 }
 
 

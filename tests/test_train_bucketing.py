@@ -131,7 +131,7 @@ def test_bucketing_lm_converges():
     arg, _ = mod.get_params()
     assert "embed_weight" in arg and "hh_weight" in arg
 
-    from tests.conftest import write_convergence_log
+    from tests._util import write_convergence_log
     write_convergence_log({"model": "bucketing_rnn_lm",
                            "val_ppl_start": round(ppl0, 2),
                            "val_ppl_final": round(ppl, 3)})

@@ -13,7 +13,6 @@ Set ``MXTPU_WRITE_CONVERGENCE_LOG=path.json`` to dump the per-epoch metric
 log (the committed CONVERGENCE artifact).
 """
 import gzip
-import json
 import os
 import struct
 
@@ -131,7 +130,7 @@ def test_train_mlp_converges(mnist_dir, tmp_path):
     fmod.forward(batch, is_train=False)
     assert fmod.get_outputs()[0].shape == (100, 64)
 
-    from tests.conftest import write_convergence_log
+    from tests._util import write_convergence_log
     write_convergence_log(log)
 
 
@@ -170,7 +169,7 @@ def test_train_lenet_converges(mnist_dir):
     acc = correct / total
     assert acc > 0.95, "LeNet did not converge: val acc %.3f" % acc
 
-    from tests.conftest import write_convergence_log
+    from tests._util import write_convergence_log
     write_convergence_log({"model": "lenet_gluon",
                            "final_val_acc": round(acc, 4)})
 
@@ -211,6 +210,6 @@ def test_train_bf16_mixed_precision_converges(mnist_dir):
     acc = correct / total
     assert acc > 0.93, "bf16 training did not converge: val acc %.3f" % acc
 
-    from tests.conftest import write_convergence_log
+    from tests._util import write_convergence_log
     write_convergence_log({"model": "lenet_bf16_spmd",
                            "final_val_acc": round(acc, 4)})

@@ -88,6 +88,6 @@ def test_train_through_augmented_image_pipeline(tmp_path):
     assert acc > 0.9, ("augmented-pipeline training did not converge: "
                        "val acc %.3f" % acc)
 
-    from tests.conftest import write_convergence_log
+    from tests._util import write_convergence_log
     write_convergence_log({"model": "cnn_recordio_augmented",
                            "final_val_acc": round(acc, 4)})
