@@ -1,0 +1,258 @@
+/* AI::MXTpu — Perl XS binding over the mxtpu core C ABI.
+ *
+ * Reference analog: perl-package/AI-MXNet (the Perl binding over
+ * libmxnet's C API, SURVEY §1 row 11).  Same architecture: a thin XS
+ * shim dlopens libmxtpu_c_api.so at runtime (no link-time dependency)
+ * and exposes the flat handle functions; the Perl-side OO wrapper lives
+ * in lib/AI/MXTpu.pm.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <dlfcn.h>
+#include <string.h>
+
+typedef const char *(*err_fn)(void);
+typedef int (*frombytes_fn)(const void *, long, const long *, int, int,
+                            void **);
+typedef int (*free_fn)(void *);
+typedef int (*shape_fn)(void *, long *, int, int *);
+typedef int (*data_fn)(void *, void *, long, long *);
+typedef int (*invoke_fn)(const char *, int, void **, int, const char **,
+                         const char **, int, void **, int *);
+typedef int (*waitall_fn)(void);
+typedef int (*listops_fn)(char *, long, long *);
+typedef int (*dtype_fn)(void *, int *);
+
+static err_fn p_err = NULL;
+static frombytes_fn p_frombytes = NULL;
+static free_fn p_free = NULL;
+static shape_fn p_shape = NULL;
+static data_fn p_data = NULL;
+static invoke_fn p_invoke = NULL;
+static waitall_fn p_waitall = NULL;
+static listops_fn p_listops = NULL;
+static dtype_fn p_dtype = NULL;
+
+static void *resolve(void *lib, const char *name) {
+  void *p = dlsym(lib, name);
+  return p;  /* _load validates the full set before publishing any */
+}
+
+static void need_lib(void) {
+  if (p_err == NULL)
+    croak("AI::MXTpu: call AI::MXTpu::load(\"libmxtpu_c_api.so\") first");
+}
+
+MODULE = AI::MXTpu  PACKAGE = AI::MXTpu
+
+PROTOTYPES: DISABLE
+
+int
+_load(path)
+    const char *path
+  CODE:
+    {
+      /* resolve into locals and publish only when COMPLETE, so a failed
+         load never leaves the module half-initialized */
+      void *lib = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+      err_fn t_err;
+      frombytes_fn t_frombytes;
+      free_fn t_free;
+      shape_fn t_shape;
+      data_fn t_data;
+      invoke_fn t_invoke;
+      waitall_fn t_waitall;
+      listops_fn t_listops;
+      dtype_fn t_dtype;
+      if (lib == NULL) croak("AI::MXTpu: dlopen failed: %s", dlerror());
+      t_err = (err_fn)resolve(lib, "MXTpuCGetLastError");
+      t_frombytes = (frombytes_fn)resolve(lib,
+                                          "MXTpuNDArrayCreateFromBytes");
+      t_free = (free_fn)resolve(lib, "MXTpuNDArrayFree");
+      t_shape = (shape_fn)resolve(lib, "MXTpuNDArrayGetShape");
+      t_data = (data_fn)resolve(lib, "MXTpuNDArrayGetData");
+      t_invoke = (invoke_fn)resolve(lib, "MXTpuImperativeInvoke");
+      t_waitall = (waitall_fn)resolve(lib, "MXTpuWaitAll");
+      t_listops = (listops_fn)resolve(lib, "MXTpuListOps");
+      t_dtype = (dtype_fn)resolve(lib, "MXTpuNDArrayGetDType");
+      if (!t_err || !t_frombytes || !t_free || !t_shape || !t_data ||
+          !t_invoke || !t_waitall || !t_listops || !t_dtype) {
+        dlclose(lib);
+        croak("AI::MXTpu: %s is not a complete mxtpu C ABI library",
+              path);
+      }
+      p_err = t_err;
+      p_frombytes = t_frombytes;
+      p_free = t_free;
+      p_shape = t_shape;
+      p_data = t_data;
+      p_invoke = t_invoke;
+      p_waitall = t_waitall;
+      p_listops = t_listops;
+      p_dtype = t_dtype;
+      RETVAL = 1;
+    }
+  OUTPUT:
+    RETVAL
+
+UV
+_nd_from_floats(values, shape)
+    AV *values
+    AV *shape
+  CODE:
+    {
+      int n;
+      need_lib();
+      n = av_len(values) + 1;
+      int nd = av_len(shape) + 1;
+      float *buf;
+      long *dims;
+      void *h = NULL;
+      int i, rc;
+      Newx(buf, n, float);
+      Newx(dims, nd, long);
+      for (i = 0; i < n; ++i) {
+        SV **e = av_fetch(values, i, 0);
+        buf[i] = (float)(e ? SvNV(*e) : 0.0);
+      }
+      for (i = 0; i < nd; ++i) {
+        SV **e = av_fetch(shape, i, 0);
+        dims[i] = (long)(e ? SvIV(*e) : 0);
+      }
+      rc = p_frombytes(buf, (long)n * (long)sizeof(float), dims, nd, 0,
+                       &h);
+      Safefree(buf);
+      Safefree(dims);
+      if (rc != 0) croak("AI::MXTpu: create failed: %s", p_err());
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(h)
+    UV h
+  CODE:
+    if (p_free != NULL) p_free(INT2PTR(void *, h));
+
+AV *
+_nd_shape(h)
+    UV h
+  CODE:
+    {
+      long dims[16];
+      int nd = 0, i;
+      need_lib();
+      if (p_shape(INT2PTR(void *, h), dims, 16, &nd) != 0)
+        croak("AI::MXTpu: shape failed: %s", p_err());
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < nd; ++i) av_push(RETVAL, newSViv(dims[i]));
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_nd_values(h)
+    UV h
+  CODE:
+    {
+      long nbytes = 0, i, n;
+      float *buf;
+      int code = -1;
+      need_lib();
+      /* the float decode below is only valid for float32 payloads */
+      if (p_dtype(INT2PTR(void *, h), &code) != 0)
+        croak("AI::MXTpu: dtype failed: %s", p_err());
+      if (code != 0)
+        croak("AI::MXTpu: values() supports float32 arrays only "
+              "(dtype code %d); Cast to float32 first", code);
+      if (p_data(INT2PTR(void *, h), NULL, 0, &nbytes) != 0)
+        croak("AI::MXTpu: data size failed: %s", p_err());
+      n = nbytes / (long)sizeof(float);
+      Newx(buf, n, float);
+      if (p_data(INT2PTR(void *, h), buf, nbytes, &nbytes) != 0) {
+        Safefree(buf);
+        croak("AI::MXTpu: data failed: %s", p_err());
+      }
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < n; ++i) av_push(RETVAL, newSVnv(buf[i]));
+      Safefree(buf);
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_invoke(op, handles, keys, vals)
+    const char *op
+    AV *handles
+    AV *keys
+    AV *vals
+  CODE:
+    {
+      int nin, nattr;
+      need_lib();
+      nin = av_len(handles) + 1;
+      nattr = av_len(keys) + 1;
+      void *ins[16];
+      void *outs[8];
+      const char *ck[16];
+      const char *cv[16];
+      int i, nout = 0;
+      if (nin > 16 || nattr > 16)
+        croak("AI::MXTpu: too many inputs/attrs");
+      for (i = 0; i < nin; ++i) {
+        SV **e = av_fetch(handles, i, 0);
+        ins[i] = e ? INT2PTR(void *, SvUV(*e)) : NULL;
+      }
+      for (i = 0; i < nattr; ++i) {
+        SV **k = av_fetch(keys, i, 0);
+        SV **v = av_fetch(vals, i, 0);
+        ck[i] = k ? SvPV_nolen(*k) : "";
+        cv[i] = v ? SvPV_nolen(*v) : "";
+      }
+      if (p_invoke(op, nin, ins, nattr, ck, cv, 8, outs, &nout) != 0)
+        croak("AI::MXTpu: invoke %s failed: %s", op, p_err());
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < nout; ++i) av_push(RETVAL, newSVuv(PTR2UV(outs[i])));
+    }
+  OUTPUT:
+    RETVAL
+
+int
+_wait_all()
+  CODE:
+    need_lib();
+    RETVAL = p_waitall();
+  OUTPUT:
+    RETVAL
+
+int
+_num_ops()
+  CODE:
+    {
+      long needed = 0;
+      char *buf;
+      long i;
+      int count = 1;
+      need_lib();
+      if (p_listops(NULL, 0, &needed) != 0)
+        croak("AI::MXTpu: list_ops failed: %s", p_err());
+      Newx(buf, needed, char);
+      if (p_listops(buf, needed, &needed) != 0) {
+        Safefree(buf);
+        croak("AI::MXTpu: list_ops failed: %s", p_err());
+      }
+      for (i = 0; buf[i] != '\0'; ++i) {
+        if (buf[i] == '\n') ++count;
+      }
+      Safefree(buf);
+      RETVAL = count;
+    }
+  OUTPUT:
+    RETVAL

@@ -1,0 +1,32 @@
+#!/usr/bin/env perl
+# AI::MXTpu demo (reference analog: perl-package/AI-MXNet/examples).
+#
+#   perl Makefile.PL && make
+#   MXTPU_C_PLATFORM=cpu PYTHONPATH=/path/to/repo \
+#     perl -Mblib examples/demo.pl /path/to/libmxtpu_c_api.so
+use strict;
+use warnings;
+use AI::MXTpu;
+
+AI::MXTpu::load($ARGV[0] // "libmxtpu_c_api.so") or die "load failed";
+
+my $a = AI::MXTpu::NDArray->new([1, 2, 3, 4, 5, 6], [2, 3]);
+my $b = AI::MXTpu::NDArray->new([10, 20, 30, 40, 50, 60], [2, 3]);
+my ($c) = AI::MXTpu::invoke("broadcast_add", [$a, $b]);
+print "add: @{ $c->values }\n";
+die "bad add" unless $c->values->[0] == 11 && $c->values->[5] == 66;
+die "bad shape" unless "@{ $c->shape }" eq "2 3";
+
+my ($sm) = AI::MXTpu::invoke("softmax", [$a], { axis => 1 });
+my @row = @{ $sm->values }[0 .. 2];
+my $sum = $row[0] + $row[1] + $row[2];
+die "bad softmax" if abs($sum - 1.0) > 1e-5;
+
+my ($fc) = AI::MXTpu::invoke("FullyConnected",
+    [$a, AI::MXTpu::NDArray->new([(0.5) x 12], [4, 3])],
+    { num_hidden => 4, no_bias => "True" });
+die "bad fc shape" unless "@{ $fc->shape }" eq "2 4";
+
+die "too few ops" unless AI::MXTpu::num_ops() > 500;
+AI::MXTpu::wait_all() == 0 or die "wait_all failed";
+print "PERL_BINDING_OK\n";

@@ -1,0 +1,74 @@
+package AI::MXTpu;
+
+# AI::MXTpu — Perl binding for the mxnet_tpu framework.
+#
+# Reference analog: perl-package/AI-MXNet (the Perl OO wrapper over
+# libmxnet's C API).  Load the core C ABI, build NDArrays from Perl
+# arrays, run any registered operator imperatively, and read values back:
+#
+#   use AI::MXTpu;
+#   AI::MXTpu::load("/path/to/libmxtpu_c_api.so");
+#   my $a = AI::MXTpu::NDArray->new([1, 2, 3, 4], [2, 2]);
+#   my $b = AI::MXTpu::NDArray->new([10, 20, 30, 40], [2, 2]);
+#   my ($c) = AI::MXTpu::invoke("broadcast_add", [$a, $b]);
+#   my @vals = @{ $c->values };          # 11 22 33 44
+#
+# Attribute values pass as strings and are literal-parsed by the runtime
+# (numbers, tuples, booleans) — the same convention the C and C++
+# bindings use.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXTpu', $VERSION);
+
+sub load {
+    my ($path) = @_;
+    return _load($path);
+}
+
+sub invoke {
+    my ($op, $inputs, $attrs) = @_;
+    my @handles = map { $_->{handle} } @{ $inputs || [] };
+    my (@k, @v);
+    for my $key (sort keys %{ $attrs || {} }) {
+        push @k, $key;
+        push @v, "" . $attrs->{$key};
+    }
+    my $outs = _invoke($op, \@handles, \@k, \@v);
+    return map { AI::MXTpu::NDArray->_adopt($_) } @$outs;
+}
+
+sub wait_all { return _wait_all() }
+
+sub num_ops { return _num_ops() }
+
+package AI::MXTpu::NDArray;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $values, $shape) = @_;
+    my $h = AI::MXTpu::_nd_from_floats($values, $shape);
+    return bless { handle => $h }, $class;
+}
+
+sub _adopt {
+    my ($class, $h) = @_;
+    return bless { handle => $h }, $class;
+}
+
+sub shape  { my ($self) = @_; return AI::MXTpu::_nd_shape($self->{handle}) }
+sub values { my ($self) = @_; return AI::MXTpu::_nd_values($self->{handle}) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_nd_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;
