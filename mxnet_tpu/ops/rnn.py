@@ -85,6 +85,14 @@ def _rnn(data, parameters, state, state_cell=None, state_size=None,
     h0_all = jnp.asarray(state)
     c0_all = jnp.asarray(state_cell) if state_cell is not None else jnp.zeros_like(h0_all)
     T, B, I = x.shape
+    if h0_all.shape[1] != B:
+        # batch-agnostic initial state (symbol.zeros with an unknown batch
+        # dim lowers to size 1) — lax.scan needs the carry at full batch
+        h0_all = jnp.broadcast_to(h0_all, (h0_all.shape[0], B,
+                                           h0_all.shape[2]))
+    if c0_all.shape[1] != B:
+        c0_all = jnp.broadcast_to(c0_all, (c0_all.shape[0], B,
+                                           c0_all.shape[2]))
     H = int(state_size)
     L = int(num_layers)
     D = 2 if bidirectional else 1

@@ -422,6 +422,12 @@ def Variable(name, shape=None, dtype=None, init=None, **attr_kwargs):
     # explicit per-variable attrs winning over the scope
     from ..attribute import AttrScope
     s._attr_map.update(AttrScope.current_attrs())
+    if init is not None:
+        # reference Variable(init=...) serializes the initializer into the
+        # __init__ attr (python/mxnet/symbol/symbol.py Variable); InitDesc
+        # routes it back through Initializer.__call__ at init_params time
+        s._attr_map["__init__"] = init if isinstance(init, str) else \
+            init.dumps()
     s._attr_map.update({k: str(v) for k, v in attr_kwargs.items()})
     return s
 
@@ -444,12 +450,24 @@ def ones(shape, dtype="float32", **_):
                          {"shape": tuple(shape), "dtype": dtype})
 
 
+def _fill_shape(shape):
+    # Reference shape semantics: a 0 dim means "unknown, solve at bind"
+    # (mx.sym.zeros(shape=(0, H)) is how RNN cells spell batch-agnostic
+    # begin_state, python/mxnet/rnn/rnn_cell.py:190-223).  The reference
+    # runs bidirectional shape inference to fill it; here inference is
+    # forward-only, so unknown dims lower to size 1 and XLA broadcasting
+    # carries them — every consumer of a begin_state symbol is broadcast
+    # math (broadcast_add/mul, FullyConnected over a batch of 1, the RNN
+    # op's explicit state broadcast).
+    return tuple(1 if s == 0 else s for s in shape)
+
+
 _registry.register("_zeros_shape", differentiable=False)(
     lambda shape=(), dtype="float32", **_:
-        jnp.zeros(shape, dtype_np(dtype)))
+        jnp.zeros(_fill_shape(shape), dtype_np(dtype)))
 _registry.register("_ones_shape", differentiable=False)(
     lambda shape=(), dtype="float32", **_:
-        jnp.ones(shape, dtype_np(dtype)))
+        jnp.ones(_fill_shape(shape), dtype_np(dtype)))
 
 
 _NAME_COUNTER = {}
@@ -483,6 +501,9 @@ _OP_INPUT_SLOTS = {
     "LinearRegressionOutput": ("data", "label"),
     "LogisticRegressionOutput": ("data", "label"),
     "MAERegressionOutput": ("data", "label"),
+    # fused RNN (reference src/operator/rnn.cc:652): parameters is the flat
+    # cuDNN-layout blob; state_cell exists only in lstm mode
+    "RNN": ("data", "parameters", "state", "state_cell"),
 }
 
 
@@ -503,6 +524,9 @@ def _make_op_node(opname, inputs, attrs):
             v = slot_vals.get(s)
             if v is None:
                 if s == "bias" and no_bias:
+                    inputs.append(None)
+                    continue
+                if s == "state_cell" and attrs.get("mode", "lstm") != "lstm":
                     inputs.append(None)
                     continue
                 if s == "data":
@@ -570,6 +594,23 @@ def _emb_param_shapes(attrs, dshape):
     return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
 
 
+def _rnn_param_shapes(attrs, dshape):
+    # data is TNC (T, B, I); parameters is the flat cuDNN-layout blob
+    # (reference src/operator/rnn-inl.h GetRnnParamSize)
+    from ..rnn._fused_layout import fused_rnn_param_size
+    h = int(attrs["state_size"])
+    layers = int(attrs.get("num_layers", 1))
+    bi = str(attrs.get("bidirectional", False)) in ("True", "true", "1")
+    mode = attrs.get("mode", "lstm")
+    d = 2 if bi else 1
+    total = fused_rnn_param_size(dshape[2], h, layers, mode, bi)
+    state = (layers * d, dshape[1], h)
+    shapes = {1: (total,), 2: state}
+    if mode == "lstm":
+        shapes[3] = state
+    return shapes
+
+
 _INT_DATA_OPS = {"Embedding", "one_hot", "take"}
 
 # unary ops that preserve their input's shape — partial shape inference may
@@ -602,6 +643,7 @@ _PARAM_SHAPE_RULES = {
     "GroupNorm": _in_param_shapes,
     "InstanceNorm": _in_param_shapes,
     "Embedding": _emb_param_shapes,
+    "RNN": _rnn_param_shapes,
 }
 
 
