@@ -42,6 +42,7 @@ _LAZY = {
     "kv": ".kvstore",
     "mod": ".module",
     "module": ".module",
+    "rnn": ".rnn",
     "callback": ".callback",
     "model": ".model",
     "profiler": ".profiler",

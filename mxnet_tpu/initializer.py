@@ -93,8 +93,11 @@ class Initializer:
             desc.global_init = self
         init_name = desc.attrs.get("__init__", "") if isinstance(desc, InitDesc) else ""
         if init_name:
+            # pass the InitDesc itself (a str subclass): attribute-driven
+            # initializers like FusedRNN need desc.global_init for the
+            # reference's "fall back to global initializer" contract
             create(json.loads(init_name)[0], **json.loads(init_name)[1])._init(
-                str(desc), arr)
+                desc, arr)
         else:
             self._init(str(desc), arr)
 
@@ -253,13 +256,17 @@ class Bilinear(Initializer):
 
 @register
 class LSTMBias(Initializer):
-    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+    """Forget-gate bias init (reference: initializer.py LSTMBias).
+
+    Overrides ``generate`` (not ``_init_weight``): this initializer is
+    *for* bias parameters, so the base class's name-suffix rule that
+    zeroes every "*bias" would silently swallow it."""
 
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
-    def _init_weight(self, name, key, shape, dtype):
+    def generate(self, key, shape, dtype="float32", name=""):
         b = _np.zeros(shape, dtype="float32")
         num_hidden = int(shape[0] / 4)
         b[num_hidden:2 * num_hidden] = self.forget_bias
@@ -289,11 +296,24 @@ class FusedRNN(Initializer):
                          forget_bias=forget_bias)
         self._inner = init
         self._num_hidden = num_hidden
+        self._num_layers = num_layers
         self._mode = mode
+        self._bidirectional = bidirectional
         self._forget_bias = forget_bias
+
+    def _init(self, name, arr):
+        # remember the job-level initializer (reference FusedRNN: "Fall
+        # back to global initializer if None", initializer.py:715-722)
+        self._global = getattr(name, "global_init", None)
+        super()._init(name, arr)
 
     def generate(self, key, shape, dtype="float32", name=""):
         lname = name.lower()
+        if len(shape) == 1 and "bias" not in lname:
+            # the FLAT packed blob (mx.rnn.FusedRNNCell 'parameters'):
+            # apply the reference contract region by region — weights get
+            # the inner init, biases zeros with the LSTM forget gate open
+            return self._generate_blob(key, shape, dtype, name)
         if "bias" in lname:
             b = _np.zeros(shape, "float32")
             if self._mode == "lstm" and "i2h" in lname:
@@ -303,6 +323,31 @@ class FusedRNN(Initializer):
         if self._inner is not None:
             return self._inner.generate(key, shape, dtype, name=name)
         return Uniform(0.07).generate(key, shape, dtype, name=name)
+
+    def _generate_blob(self, key, shape, dtype, name):
+        import jax as _jax
+        from .rnn._fused_layout import fused_rnn_regions, fused_rnn_num_input
+        weight_init = self._inner or getattr(self, "_global", None) \
+            or Uniform(0.07)
+        h = self._num_hidden
+        ni = fused_rnn_num_input(int(shape[0]), h, self._num_layers,
+                                 self._mode, self._bidirectional)
+        regions, total = fused_rnn_regions(ni, h, self._num_layers,
+                                           self._mode, self._bidirectional)
+        assert total == int(shape[0]), \
+            "FusedRNN blob size %d does not match the cell geometry %d" \
+            % (shape[0], total)
+        blob = _np.zeros((total,), "float32")
+        for rname, off, rshape, kind in regions:
+            size = int(_np.prod(rshape))
+            if kind.endswith("_weight"):
+                key, sub = _jax.random.split(key)
+                blob[off:off + size] = _np.asarray(weight_init.generate(
+                    sub, rshape, "float32", name=rname)).reshape(-1)
+            elif self._mode == "lstm" and kind == "i2h_bias" \
+                    and "_i2h_f_" in rname:
+                blob[off:off + size] = self._forget_bias  # forget gate
+        return jnp.asarray(blob, dtype_np(dtype))
 
 
 class Mixed:

@@ -98,31 +98,23 @@ def _rnn(data, parameters, state, state_cell=None, state_size=None,
     D = 2 if bidirectional else 1
     ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
 
-    # slice the flat parameter blob in cuDNN layout: for each layer, for each
-    # direction: i2h_w (G*H, in), h2h_w (G*H, H); then all biases in the same
-    # order (reference rnn-inl.h GetRnnParamSize)
-    def take(offset, shape):
-        size = 1
-        for s in shape:
-            size *= s
-        return w[offset:offset + size].reshape(shape), offset + size
-
-    weights = []
-    off = 0
-    for layer in range(L):
-        inp = I if layer == 0 else H * D
-        per_dir = []
-        for d in range(D):
-            i2h, off = take(off, (ngates * H, inp))
-            h2h, off = take(off, (ngates * H, H))
-            per_dir.append([i2h, h2h, None, None])
-        weights.append(per_dir)
-    for layer in range(L):
-        for d in range(D):
-            i2h_b, off = take(off, (ngates * H,))
-            h2h_b, off = take(off, (ngates * H,))
-            weights[layer][d][2] = i2h_b
-            weights[layer][d][3] = h2h_b
+    # slice the flat parameter blob: the layout lives in ONE place
+    # (mxnet_tpu/rnn/_fused_layout.py, the cuDNN order of reference
+    # rnn-inl.h GetRnnParamSize) shared with pack/unpack and the
+    # FusedRNN initializer
+    from ..rnn._fused_layout import fused_rnn_group_slices
+    gb = ngates * H
+    weights = [[None] * D for _ in range(L)]
+    groups = fused_rnn_group_slices(I, H, L, mode, bool(bidirectional))
+    for grp, (iw_off, iw_shape, hw_off, hw_shape, ib_off, hb_off) \
+            in enumerate(groups):
+        layer, d = divmod(grp, D)
+        weights[layer][d] = [
+            w[iw_off:iw_off + gb * iw_shape[1]].reshape(iw_shape),
+            w[hw_off:hw_off + gb * H].reshape(hw_shape),
+            w[ib_off:ib_off + gb],
+            w[hb_off:hb_off + gb],
+        ]
 
     out = x
     h_n = []

@@ -55,6 +55,33 @@ def fused_rnn_regions(num_input, num_hidden, num_layers, mode,
     return out, off
 
 
+def fused_rnn_group_slices(num_input, num_hidden, num_layers, mode,
+                           bidirectional=False):
+    """Gate-stacked views of the blob, one record per (layer, direction):
+    ``(i2h_w_off, i2h_w_shape, h2h_w_off, h2h_w_shape, i2h_b_off,
+    h2h_b_off)`` with weight shapes ``(G*H, in)``/``(G*H, H)`` and biases
+    ``(G*H,)``.  Valid because per-gate regions are contiguous in
+    traversal order — this is what the executor (ops/rnn.py _rnn) slices,
+    derived from the same walk as pack/unpack/init."""
+    regions, _ = fused_rnn_regions(num_input, num_hidden, num_layers, mode,
+                                   bidirectional)
+    by_kind = {}
+    for _, off, shape, kind in regions:
+        by_kind.setdefault(kind, []).append((off, shape))
+    g = len(GATES[mode])
+    ndirs = 2 if bidirectional else 1
+    out = []
+    for grp in range(num_layers * ndirs):
+        i2h = by_kind["i2h_weight"][grp * g:(grp + 1) * g]
+        h2h = by_kind["h2h_weight"][grp * g:(grp + 1) * g]
+        i2h_b = by_kind["i2h_bias"][grp * g:(grp + 1) * g]
+        h2h_b = by_kind["h2h_bias"][grp * g:(grp + 1) * g]
+        out.append((i2h[0][0], (g * num_hidden, i2h[0][1][1]),
+                    h2h[0][0], (g * num_hidden, num_hidden),
+                    i2h_b[0][0], h2h_b[0][0]))
+    return out
+
+
 def fused_rnn_param_size(num_input, num_hidden, num_layers, mode,
                          bidirectional=False):
     _, size = fused_rnn_regions(num_input, num_hidden, num_layers, mode,

@@ -1,0 +1,268 @@
+"""mx.rnn — legacy symbolic RNN cells, fused blob pack/unpack, bucketing
+IO (reference: tests/python/unittest/test_rnn.py, the de-facto contract
+for python/mxnet/rnn/rnn_cell.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _forward(sym, **shapes):
+    ex = sym.simple_bind(**shapes)
+    return ex, [o.asnumpy() for o in ex.forward()]
+
+
+def test_rnn_cell_unroll_shapes_and_args():
+    cell = mx.rnn.RNNCell(50, prefix="rnn_")
+    outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                             merge_outputs=False)
+    out = mx.sym.Group(outputs)
+    args = set(out.list_arguments())
+    # one shared parameter set across timesteps (reference test_rnn)
+    assert {"rnn_i2h_weight", "rnn_i2h_bias", "rnn_h2h_weight",
+            "rnn_h2h_bias", "data"} <= args
+    _, outs = _forward(out, data=(10, 3, 20))
+    assert [o.shape for o in outs] == [(10, 50)] * 3
+
+
+def test_lstm_cell_unroll_merged():
+    cell = mx.rnn.LSTMCell(25, prefix="lstm_")
+    outputs, states = cell.unroll(4, mx.sym.Variable("data"),
+                                  layout="NTC", merge_outputs=True)
+    assert len(states) == 2
+    _, outs = _forward(outputs, data=(8, 4, 10))
+    assert outs[0].shape == (8, 4, 25)
+
+
+def test_gru_cell_step_math_matches_numpy():
+    # step the cell by hand and check the cuDNN-variant GRU equations
+    H, B, I = 3, 2, 4
+    cell = mx.rnn.GRUCell(H, prefix="g_")
+    x = mx.sym.Variable("x")
+    h = mx.sym.Variable("h")
+    out, _ = cell(x, [h])
+    ex = out.simple_bind(x=(B, I), h=(B, H))
+    rng = np.random.RandomState(3)
+    vals = {"x": rng.randn(B, I), "h": rng.randn(B, H),
+            "g_i2h_weight": rng.randn(3 * H, I),
+            "g_i2h_bias": rng.randn(3 * H),
+            "g_h2h_weight": rng.randn(3 * H, H),
+            "g_h2h_bias": rng.randn(3 * H)}
+    for k, v in vals.items():
+        ex.arg_dict[k][:] = v
+    got = ex.forward()[0].asnumpy()
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    i2h = vals["x"] @ vals["g_i2h_weight"].T + vals["g_i2h_bias"]
+    h2h = vals["h"] @ vals["g_h2h_weight"].T + vals["g_h2h_bias"]
+    ir, iz, inn = np.split(i2h, 3, axis=1)
+    hr, hz, hn = np.split(h2h, 3, axis=1)
+    r, z = sig(ir + hr), sig(iz + hz)
+    want = (1 - z) * np.tanh(inn + r * hn) + z * vals["h"]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_stacked_residual_dropout_unroll():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="l1_")))
+    stack.add(mx.rnn.DropoutCell(0.3))
+    outputs, states = stack.unroll(5, mx.sym.Variable("data"),
+                                   merge_outputs=True)
+    # 2 LSTM cells x (h, c)
+    assert len(states) == 4
+    _, outs = _forward(outputs, data=(4, 5, 8))
+    assert outs[0].shape == (4, 5, 8)
+
+
+def test_bidirectional_concat_shapes():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.GRUCell(8, prefix="f_"),
+                                  mx.rnn.GRUCell(8, prefix="b_"))
+    outputs, _ = bi.unroll(5, mx.sym.Variable("data"),
+                           merge_outputs=True)
+    _, outs = _forward(outputs, data=(4, 5, 6))
+    assert outs[0].shape == (4, 5, 16)
+
+
+def test_zoneout_cell_runs():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(8, prefix="z_"),
+                              zoneout_outputs=0.5, zoneout_states=0.5)
+    outputs, _ = cell.unroll(4, mx.sym.Variable("data"),
+                             merge_outputs=True)
+    _, outs = _forward(outputs, data=(4, 4, 8))
+    assert outs[0].shape == (4, 4, 8)
+
+
+def test_unpack_pack_roundtrip_lstm():
+    cell = mx.rnn.LSTMCell(6, prefix="lstm_")
+    rng = np.random.RandomState(0)
+    args = {"lstm_i2h_weight": mx.nd.array(rng.randn(24, 5)),
+            "lstm_i2h_bias": mx.nd.array(rng.randn(24)),
+            "lstm_h2h_weight": mx.nd.array(rng.randn(24, 6)),
+            "lstm_h2h_bias": mx.nd.array(rng.randn(24))}
+    unpacked = cell.unpack_weights(dict(args))
+    # per-gate names, i,f,c,o order
+    assert "lstm_i2h_f_weight" in unpacked and \
+        "lstm_h2h_o_bias" in unpacked
+    np.testing.assert_allclose(
+        unpacked["lstm_i2h_f_weight"].asnumpy(),
+        args["lstm_i2h_weight"].asnumpy()[6:12])
+    packed = cell.pack_weights(unpacked)
+    for k in args:
+        np.testing.assert_allclose(packed[k].asnumpy(),
+                                   args[k].asnumpy())
+
+
+@pytest.mark.parametrize("mode,bi", [("lstm", False), ("gru", True),
+                                     ("rnn_tanh", False)])
+def test_fused_cell_matches_unfused(mode, bi):
+    """FusedRNNCell (lax.scan RNN op) == its unfuse() stack, weights
+    shared through pack/unpack (the reference's core fused-vs-unfused
+    consistency check)."""
+    T, B, I, H, L = 3, 2, 4, 5, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                                bidirectional=bi, prefix="f_")
+    fo, _ = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    fex = fo.simple_bind(data=(B, T, I))
+    rng = np.random.RandomState(7)
+    blob = rng.uniform(-0.5, 0.5,
+                       fex.arg_dict["f_parameters"].shape).astype("f")
+    fex.arg_dict["f_parameters"][:] = blob
+    data = rng.randn(B, T, I).astype("f")
+    fex.arg_dict["data"][:] = data
+    fused_out = fex.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    so, _ = stack.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    sex = so.simple_bind(data=(B, T, I))
+    # fused blob -> per-gate names -> the stack's gate-stacked params
+    shared = stack.pack_weights(
+        fused.unpack_weights({"f_parameters": mx.nd.array(blob)}))
+    sex.arg_dict["data"][:] = data
+    for name, arr in shared.items():
+        if name in sex.arg_dict:
+            sex.arg_dict[name][:] = arr.asnumpy()
+    unfused_out = sex.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_fused_pack_unpack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(4, num_layers=2, mode="gru",
+                                bidirectional=True, prefix="g_")
+    rng = np.random.RandomState(1)
+    from mxnet_tpu.rnn._fused_layout import fused_rnn_param_size
+    total = fused_rnn_param_size(3, 4, 2, "gru", True)
+    blob = rng.randn(total).astype("f")
+    unpacked = fused.unpack_weights({"g_parameters": mx.nd.array(blob)})
+    assert "g_r0_i2h_z_weight" in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["g_parameters"].asnumpy(), blob)
+
+
+def test_conv_cells_unroll():
+    for cls, nh in [(mx.rnn.ConvRNNCell, 4), (mx.rnn.ConvLSTMCell, 4),
+                    (mx.rnn.ConvGRUCell, 4)]:
+        cell = cls(input_shape=(1, 3, 8, 8), num_hidden=nh)
+        outputs, _ = cell.unroll(2, mx.sym.Variable("data"),
+                                 merge_outputs=False)
+        _, outs = _forward(outputs[-1], data=(2, 2, 3, 8, 8))
+        assert outs[0].shape == (2, nh, 8, 8)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.LSTMCell(5, prefix="lstm_")
+    outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                             merge_outputs=True)
+    ex = outputs.simple_bind(data=(2, 3, 4))
+    rng = np.random.RandomState(2)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.randn(*arr.shape)
+    arg = {n: v.copy() for n, v in ex.arg_dict.items() if n != "data"}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 7, outputs, arg, {})
+    sym, arg2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 7)
+    for k in arg:
+        np.testing.assert_allclose(arg2[k].asnumpy(), arg[k].asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "b"],
+                 ["c", "a"], ["a", "b", "c"], ["b", "a"]]
+    enc, vocab = mx.rnn.encode_sentences(sentences, start_label=1)
+    assert sorted(vocab) == ["\n", "a", "b", "c"]
+    it = mx.rnn.BucketSentenceIter(enc, batch_size=2, buckets=[2, 3, 4],
+                                   invalid_label=0)
+    keys = set()
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.data[0].shape[1] == batch.bucket_key
+        # label is data shifted one left
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        keys.add(batch.bucket_key)
+        n += 1
+    assert n >= 2 and len(keys) >= 2
+    # iterator resets cleanly
+    it.reset()
+    assert sum(1 for _ in it) == n
+
+
+def test_bucketing_module_with_rnn_cells():
+    """The classic path: mx.rnn cells + BucketSentenceIter +
+    BucketingModule (reference example/rnn/bucketing)."""
+    V, E, H, B = 11, 6, 8, 4
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(60):
+        length = int(rng.choice([3, 5]))
+        t = int(rng.randint(1, V))
+        s = [t]
+        for _ in range(length - 1):
+            t = (2 * t + 1) % V or 1
+            s.append(t)
+        sentences.append(s)
+    it = mx.rnn.BucketSentenceIter(sentences, B, buckets=[3, 5],
+                                   invalid_label=0)
+
+    cell = mx.rnn.LSTMCell(H, prefix="lstm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                 name="embed")
+        cell.reset()
+        outputs, _ = cell.unroll(seq_len, embed, merge_outputs=True)
+        pred = mx.sym.FullyConnected(
+            mx.sym.Reshape(outputs, shape=(-1, H)), num_hidden=V,
+            name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, lab, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for _ in range(4):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+    assert len(mod._buckets) == 2
+    assert metric.get()[1] < 6.0, \
+        "perplexity did not improve: %s" % metric.get()[1]
