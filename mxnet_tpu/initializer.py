@@ -320,8 +320,9 @@ class FusedRNN(Initializer):
                 h = self._num_hidden
                 b[h:2 * h] = self._forget_bias
             return jnp.asarray(b, dtype_np(dtype))
-        if self._inner is not None:
-            return self._inner.generate(key, shape, dtype, name=name)
+        inner = self._inner or getattr(self, "_global", None)
+        if inner is not None:
+            return inner.generate(key, shape, dtype, name=name)
         return Uniform(0.07).generate(key, shape, dtype, name=name)
 
     def _generate_blob(self, key, shape, dtype, name):

@@ -1,0 +1,106 @@
+"""FeedForward train gates (reference: tests/python/train/test_conv.py —
+conv net trained through the legacy mx.model.FeedForward estimator — and
+test_dtype.py — training with uint8/int8 input pipelines through Cast).
+
+Data is the synthetic MNIST-class glyph task from test_train_mlp (same
+generator, numpy arrays fed directly so FeedForward's numpy→NDArrayIter
+wrapping is the path under test)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+from tests.test_train_mlp import _make_glyphs
+
+
+def _conv_net(input_dtype=None):
+    data = mx.sym.Variable("data")
+    if input_dtype is not None:
+        # reference test_dtype.py: uint8/int8 pipelines Cast to float32
+        # before the first conv
+        data = mx.sym.Cast(data, dtype="float32")
+        data = data / 255.0
+    conv1 = mx.sym.Convolution(data, name="conv1", num_filter=16,
+                               kernel=(3, 3), stride=(2, 2))
+    bn1 = mx.sym.BatchNorm(conv1, name="bn1")
+    act1 = mx.sym.Activation(bn1, name="relu1", act_type="relu")
+    mp1 = mx.sym.Pooling(act1, name="mp1", kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    conv2 = mx.sym.Convolution(mp1, name="conv2", num_filter=16,
+                               kernel=(3, 3), stride=(2, 2))
+    bn2 = mx.sym.BatchNorm(conv2, name="bn2")
+    act2 = mx.sym.Activation(bn2, name="relu2", act_type="relu")
+    fl = mx.sym.Flatten(act2, name="flatten")
+    fc2 = mx.sym.FullyConnected(fl, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="sm")
+
+
+def _glyph_arrays(n, seed, dtype="float32"):
+    x, y = _make_glyphs(n, seed)
+    x = x.reshape(n, 1, 28, 28)
+    if dtype == "float32":
+        return x.astype("float32") / 255.0, y.astype("float32")
+    return x.astype(dtype), y.astype("float32")
+
+
+def test_feedforward_conv_converges_and_roundtrips(tmp_path):
+    x, y = _glyph_arrays(1600, seed=0)
+    xv, yv = _glyph_arrays(400, seed=1)
+    with pytest.warns(DeprecationWarning):
+        # reference test_conv.py hyperparams (sgd, lr 0.1, momentum 0.9,
+        # wd 1e-4); Xavier instead of the Uniform(0.01) default because
+        # this synthetic gate has 37x fewer updates per epoch than 60k
+        # MNIST for the same "converges to >0.9" contract
+        model = mx.model.FeedForward(
+            _conv_net(), ctx=mx.cpu(), num_epoch=8,
+            optimizer="sgd", initializer=mx.init.Xavier(),
+            numpy_batch_size=100,
+            learning_rate=0.1, momentum=0.9, wd=1e-4)
+    model.fit(x, y, eval_data=(xv, yv))
+    acc = model.score(mx.io.NDArrayIter(xv, yv, 100, label_name="sm_label"))
+    assert acc > 0.9, "FeedForward conv gate did not converge: %.3f" % acc
+
+    # predict: numpy in, numpy out, prob rows sum to 1
+    prob = model.predict(xv)
+    assert prob.shape == (400, 10)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-4)
+
+    # save -> load -> same predictions (reference FeedForward.load)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)  # default epoch = num_epoch
+    with pytest.warns(DeprecationWarning):
+        loaded = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu())
+    prob2 = loaded.predict(xv)
+    np.testing.assert_allclose(prob2, prob, rtol=1e-4, atol=1e-5)
+
+    from tests._util import write_convergence_log
+    write_convergence_log({"model": "feedforward_conv",
+                           "val_acc": round(float(acc), 4)})
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "int8"])
+def test_feedforward_low_precision_input_pipeline(dtype):
+    """reference test_dtype.py: the input iterator serves uint8/int8
+    batches; the graph Casts to float32 — training must still converge."""
+    x, y = _glyph_arrays(1200, seed=2, dtype=dtype)
+    if dtype == "int8":
+        x = (x.astype(np.int16) - 128).astype(np.int8)
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward(
+            _conv_net(input_dtype=dtype), ctx=mx.cpu(), num_epoch=4,
+            optimizer="adam", numpy_batch_size=100, learning_rate=2e-3)
+    model.fit(x, y)
+    xv, yv = _glyph_arrays(300, seed=3, dtype=dtype)
+    if dtype == "int8":
+        xv = (xv.astype(np.int16) - 128).astype(np.int8)
+    acc = model.score(mx.io.NDArrayIter(xv, yv, 100, label_name="sm_label"))
+    assert acc > 0.85, "%s input gate did not converge: %.3f" % (dtype, acc)
+
+
+def test_feedforward_create_shortcut():
+    x, y = _glyph_arrays(800, seed=4)
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward.create(
+            _conv_net(), x, y, ctx=mx.cpu(), num_epoch=2,
+            optimizer="adam", learning_rate=2e-3, numpy_batch_size=100)
+    assert model.arg_params and "conv1_weight" in model.arg_params
