@@ -1,0 +1,123 @@
+"""Bucketed LSTM language model via the legacy symbolic stack.
+
+Reference analog: example/rnn/bucketing/lstm_bucketing.py — mx.rnn cells
+unrolled per bucket length, BucketSentenceIter batching, BucketingModule
+sharing one parameter set across bucket graphs, rnn-checkpoint callback.
+
+Here each bucket graph jit-compiles once per length (the per-bucket
+executor IS the shape-specialized cache); pass --fused to build the
+whole sequence through FusedRNNCell's lax.scan `RNN` op instead of
+explicit unrolling.
+
+By default trains on a synthetic deterministic-next-token corpus so the
+script is self-contained; perplexity must fall far below the uniform
+baseline.  Pass --text FILE (one sentence per line, whitespace-tokenized)
+for real data, mirroring the reference's PTB recipe.
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
+import argparse
+
+import numpy as np
+
+import _common
+import mxnet_tpu as mx
+
+
+def synthetic_corpus(n_sentences, vocab, rng):
+    out = []
+    for _ in range(n_sentences):
+        length = int(rng.choice([8, 16, 24]))
+        t = int(rng.randint(1, vocab))
+        sent = [t]
+        for _ in range(length - 1):
+            t = (5 * t + 3) % vocab or 1
+            if rng.uniform() < 0.05:
+                t = int(rng.randint(1, vocab))
+            sent.append(t)
+        out.append(sent)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    _common.add_device_flag(ap)
+    ap.add_argument("--text", default=None,
+                    help="one sentence per line, whitespace-tokenized")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--sentences", type=int, default=800)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--fused", action="store_true",
+                    help="FusedRNNCell (one lax.scan over the sequence) "
+                         "instead of per-step unrolling")
+    ap.add_argument("--checkpoint", default=None,
+                    help="prefix for mx.rnn.do_rnn_checkpoint saves")
+    args = ap.parse_args()
+    _common.apply_device_flag(args)
+
+    if args.text:
+        with open(args.text) as f:
+            tokenized = [line.split() for line in f if line.strip()]
+        sentences, vocab_map = mx.rnn.encode_sentences(tokenized,
+                                                       start_label=1,
+                                                       invalid_label=0)
+        vocab = max(max(s) for s in sentences) + 1
+    else:
+        sentences = synthetic_corpus(args.sentences, args.vocab,
+                                     np.random.RandomState(0))
+        vocab = args.vocab
+
+    it = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                   invalid_label=0)
+
+    if args.fused:
+        cell = mx.rnn.FusedRNNCell(args.hidden, num_layers=args.layers,
+                                   mode="lstm", prefix="lstm_")
+    else:
+        cell = mx.rnn.SequentialRNNCell()
+        for i in range(args.layers):
+            cell.add(mx.rnn.LSTMCell(args.hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab,
+                                 output_dim=args.embed, name="embed")
+        cell.reset()
+        outputs, _ = cell.unroll(seq_len, inputs=embed,
+                                 merge_outputs=True)
+        pred = mx.sym.FullyConnected(
+            mx.sym.Reshape(outputs, shape=(-1, args.hidden)),
+            num_hidden=vocab, name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, lab, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    cb = (mx.rnn.do_rnn_checkpoint(cell, args.checkpoint)
+          if args.checkpoint else None)
+    mod.fit(it, eval_metric=mx.metric.Perplexity(ignore_label=0),
+            epoch_end_callback=cb,
+            initializer=mx.init.Xavier(),
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            num_epoch=args.epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       frequent=20))
+    print("buckets trained:", sorted(it.buckets),
+          "(uniform ppl would be ~%d)" % vocab)
+
+
+if __name__ == "__main__":
+    main()

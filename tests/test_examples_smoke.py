@@ -37,6 +37,13 @@ def test_rnn_lm_example():
         or "epoch" in out.lower()
 
 
+def test_rnn_bucketing_example():
+    out = _run("examples/rnn_bucketing.py", "--epochs", "1",
+               "--sentences", "128", "--batch-size", "16",
+               "--hidden", "32", "--embed", "16", "--layers", "1")
+    assert "buckets trained" in out.lower()
+
+
 def test_bert_pretrain_example():
     out = _run("examples/bert_pretrain.py", "--layers", "1", "--steps", "2")
     assert "sequences/s" in out

@@ -1,0 +1,164 @@
+"""Step-trace capture for the headline ResNet-50 training config.
+
+VERDICT r4 ask #2's evidence arm: "a step-trace showing the flagged
+formulation hitting its predicted ceiling".  Profiles the bf16 BS128
+NHWC_HWIO train step (the measured-best bench config) on the real chip
+through `mx.profiler` (jax trace capture underneath), classifies the
+per-device-op time into convolution / batchnorm-stats / layout-copy /
+other buckets, and writes PROFILE_r05.json.
+
+Hardened for the axon tunnel the same way bench.py is: the patient
+backend probe runs before anything touches a device, and every phase is
+reported as parseable JSON even on failure.
+
+Usage: python tools/profile_step.py [--out PROFILE_r05.json] [--iters 10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402  (the probe + constants live there)
+
+
+def classify(op_name):
+    n = op_name.lower()
+    if "conv" in n or "dot" in n or "einsum" in n:
+        return "convolution/matmul"
+    if "reduce" in n or "batchnorm" in n or "norm" in n or "variance" in n:
+        return "reductions (BN statistics)"
+    if "transpose" in n or "copy" in n or "reshape" in n or "bitcast" in n:
+        return "layout/copy"
+    if "all-reduce" in n or "allreduce" in n or "collective" in n:
+        return "collectives"
+    return "other (fused elementwise, optimizer...)"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(ROOT, "PROFILE_r05.json"))
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--layout", default="NHWC_HWIO")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the host CPU backend (shakeout runs; "
+                         "sitecustomize overrides JAX_PLATFORMS, so this "
+                         "uses jax.config)")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+
+    result = {"config": {"dtype": "bfloat16", "batch": args.batch,
+                         "conv_layout": args.layout,
+                         "iters_profiled": args.iters}}
+
+    devices, err = bench._probe_backend(900.0)
+    if devices is None:
+        result["error"] = "backend init failed: %s" % err
+        json.dump(result, open(args.out, "w"), indent=1)
+        print(json.dumps({"profile": "failed", "error": err}))
+        return
+    platform = devices[0].platform
+    result["platform"] = platform
+    result["device_kind"] = getattr(devices[0], "device_kind", "")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxnet_tpu as mx
+    import mxnet_tpu.config as _cfg
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    _cfg.set("conv.internal_layout",
+             "NHWC" if args.layout.startswith("NHWC") else "native")
+    _cfg.set("conv.weights_layout",
+             "HWIO" if args.layout.endswith("HWIO") else "ref")
+
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    rng = np.random.RandomState(0)
+    mesh = make_mesh({"dp": -1})
+    with jax.default_device(cpu0):
+        net = vision.get_model("resnet50_v1", classes=1000)
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.array(rng.uniform(
+            size=(16, 3, 224, 224)).astype(np.float32)))
+        tr = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4}, mesh=mesh, dtype="bfloat16")
+        data = rng.uniform(size=(args.batch, 3, 224, 224)).astype(
+            np.float32)
+        label = rng.randint(0, 1000, (args.batch,)).astype(np.float32)
+        tr._materialize(data)
+
+    loss = tr.step(data, label)              # compile + transfer
+    np.asarray(loss)
+    ddev = jax.device_put(jnp.asarray(data), tr._batch_sharding)
+    ldev = jax.device_put(jnp.asarray(label), tr._batch_sharding)
+    for _ in range(3):                       # warm
+        loss = tr.step(ddev, ldev)
+    np.asarray(loss)
+
+    trace_dir = tempfile.mkdtemp(prefix="mxtpu_profile_")
+    mx.profiler.set_config(trace_dir=trace_dir)
+    t0 = time.perf_counter()
+    mx.profiler.start()
+    for _ in range(args.iters):
+        loss = tr.step(ddev, ldev)
+    np.asarray(loss)
+    mx.profiler.stop()
+    wall = time.perf_counter() - t0
+    step_ms = wall / args.iters * 1e3
+    img_s = args.batch * args.iters / wall
+    result["measured"] = {
+        "step_ms": round(step_ms, 2),
+        "img_s": round(img_s, 2),
+        "mfu_vs_bf16_peak": round(
+            img_s * bench.TRAIN_FLOPS_PER_IMG / 1e12 / 197.0, 4),
+        "note": "profiled steps include trace overhead; the bench number "
+                "(BENCH_SESSION_r05.json) is the clean throughput",
+    }
+
+    ops = mx.profiler.device_op_events(trace_dir)
+    if not ops:
+        result["device_ops"] = None
+        result["note"] = ("no device plane in trace (cpu backend or trace "
+                         "capture unsupported over this tunnel)")
+    else:
+        per_class = {}
+        rows = []
+        for name, durs in ops.items():
+            total = sum(durs)
+            per_class[classify(name)] = \
+                per_class.get(classify(name), 0.0) + total
+            rows.append((total, len(durs), name))
+        rows.sort(reverse=True)
+        total_all = sum(per_class.values()) or 1.0
+        result["per_class_ms_per_step"] = {
+            k: round(v / args.iters * 1e3, 3) for k, v in
+            sorted(per_class.items(), key=lambda kv: -kv[1])}
+        result["per_class_fraction"] = {
+            k: round(v / total_all, 4) for k, v in
+            sorted(per_class.items(), key=lambda kv: -kv[1])}
+        result["device_busy_ms_per_step"] = round(
+            total_all / args.iters * 1e3, 3)
+        result["top_ops"] = [
+            {"op": name[:120], "calls": calls,
+             "ms_per_step": round(total / args.iters * 1e3, 3)}
+            for total, calls, name in rows[:25]]
+    json.dump(result, open(args.out, "w"), indent=1)
+    print(json.dumps({"profile": "ok", "step_ms": result["measured"][
+        "step_ms"], "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()
