@@ -33,10 +33,15 @@ jax.config.update("jax_default_matmul_precision", "float32")
 @pytest.fixture(autouse=True)
 def _seed_rng():
     """Reference: tests/python/unittest/common.py with_seed() — reproducible
-    randomness per test."""
+    randomness per test.  Seeds ALL three sources the reference does:
+    the framework RNG, numpy, and Python's random (mx.image augmenters
+    draw from the latter — unseeded it made convergence gates flaky)."""
+    import random as _pyrandom
+
     import mxnet_tpu as mx
     mx.random.seed(42)
     _np.random.seed(42)
+    _pyrandom.seed(42)
     yield
 
 
