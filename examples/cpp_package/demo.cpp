@@ -74,6 +74,39 @@ int main(int argc, char **argv) {
       }
     }
 
+    /* graph COMPOSITION in C++ (mxnet-cpp Operator::CreateSymbol analog):
+       data -> FC(3->2, identity weights via CopyParams) -> relu */
+    {
+      mxtpu::SymbolOp fc_op(lib, "FullyConnected");
+      {
+        /* input Symbols may die before CreateSymbol — the builder
+           retains their handles */
+        auto data = mxtpu::Symbol::Variable(lib, "data");
+        fc_op.SetParam("num_hidden", 2)
+            .SetParam("no_bias", true)
+            .SetInput("data", data);
+      }
+      auto fc = fc_op.CreateSymbol("fc1");
+      auto act = mxtpu::SymbolOp(lib, "Activation")
+                     .SetParam("act_type", "relu")
+                     .SetInput("data", fc)
+                     .CreateSymbol("relu1");
+      auto args = act.ListArguments();
+      std::printf("composed args: %zu\n", args.size());
+      if (args != std::vector<std::string>({"data", "fc1_weight"}))
+        return 1;
+      auto ex = mxtpu::Executor::SimpleBind(act, {{"data", {2, 3}}});
+      mxtpu::NDArray w(lib, {1, 0, 0, 0, -1, 0}, {2, 3});
+      if (ex.CopyParams({{"fc1_weight", &w}}) != 1) return 1;
+      mxtpu::NDArray xin(lib, {1, 2, 3, -4, 5, 6}, {2, 3});
+      auto outs = ex.Forward({{"data", &xin}});
+      auto v = outs[0].CopyTo();
+      std::printf("composed out: %.0f %.0f %.0f %.0f\n", v[0], v[1], v[2],
+                  v[3]);
+      /* rows: [1,2,3] -> [1, -2] -> relu [1, 0]; [-4,5,6] -> [-4,-5] -> [0,0] */
+      if (v != std::vector<float>({1.f, 0.f, 0.f, 0.f})) return 1;
+    }
+
     /* autograd: d(sum(x*x))/dx = 2x, through the RAII record scope */
     mxtpu::NDArray xa(lib, {1, -2, 3}, {3});
     mxtpu::autograd::MarkVariable(xa);

@@ -66,6 +66,10 @@ class Lib {
   using exfwd_fn = int (*)(void *, int, const char **, void **, int,
                            int *);
   using exout_fn = int (*)(void *, int, void **);
+  using symvar_fn = int (*)(const char *, void **);
+  using symcompose_fn = int (*)(const char *, int, const char **,
+                                const char **, int, const char **, void **,
+                                const char *, void **);
 
   static std::shared_ptr<Lib> Load(const std::string &path) {
     auto lib = std::shared_ptr<Lib>(new Lib());
@@ -105,6 +109,9 @@ class Lib {
   free_fn nd_load_free_ = nullptr;
   invoke_fn invoke_ = nullptr;
   symjson_fn sym_from_json_ = nullptr;
+  symvar_fn sym_variable_ = nullptr;
+  symcompose_fn sym_compose_ = nullptr;
+  mark_fn sym_retain_ = nullptr;
   symto_fn sym_to_json_ = nullptr;
   symto_fn sym_list_arguments_ = nullptr;
   symto_fn sym_list_outputs_ = nullptr;
@@ -146,6 +153,9 @@ class Lib {
     Sym(&nd_load_free_, "MXTpuNDArrayLoadFree");
     Sym(&invoke_, "MXTpuImperativeInvoke");
     Sym(&sym_from_json_, "MXTpuSymbolCreateFromJSON");
+    Sym(&sym_variable_, "MXTpuSymbolCreateVariable");
+    Sym(&sym_compose_, "MXTpuSymbolCompose");
+    Sym(&sym_retain_, "MXTpuSymbolRetain");
     Sym(&sym_to_json_, "MXTpuSymbolToJSON");
     Sym(&sym_list_arguments_, "MXTpuSymbolListArguments");
     Sym(&sym_list_outputs_, "MXTpuSymbolListOutputs");
@@ -342,6 +352,16 @@ inline void PackPairs(
   }
 }
 
+// Attr values cross the C ABI as strings (the runtime literal-parses
+// numbers/tuples/bools, matching the reference's dmlc::Parameter).
+inline std::string ToString(const std::string &v) { return v; }
+inline std::string ToString(const char *v) { return v; }
+inline std::string ToString(bool v) { return v ? "True" : "False"; }
+template <typename T>
+inline std::string ToString(const T &v) {
+  return std::to_string(v);
+}
+
 }  // namespace detail
 
 // Imperative operator invocation (reference mxnet-cpp Operator chaining).
@@ -396,6 +416,13 @@ class Symbol {
     return Symbol(lib, h);
   }
 
+  // Reference: mx.sym.Variable / MXSymbolCreateVariable.
+  static Symbol Variable(const LibPtr &lib, const std::string &name) {
+    void *h = nullptr;
+    lib->Check(lib->sym_variable_(name.c_str(), &h));
+    return Symbol(lib, h);
+  }
+
   Symbol(Symbol &&o) noexcept : lib_(std::move(o.lib_)), handle_(o.handle_) {
     o.handle_ = nullptr;
   }
@@ -434,8 +461,80 @@ class Symbol {
     return detail::SplitLines(s);
   }
 
+  friend class SymbolOp;
+
   LibPtr lib_;
   void *handle_ = nullptr;
+};
+
+// Graph-building operator — the mxnet-cpp Operator::CreateSymbol analog
+// (cpp-package/include/mxnet-cpp/operator.h): compose networks in C++
+// without writing symbol JSON.
+//
+//   auto data = Symbol::Variable(lib, "data");
+//   auto fc = SymbolOp(lib, "FullyConnected")
+//                 .SetParam("num_hidden", 64)
+//                 .SetInput("data", data)
+//                 .CreateSymbol("fc1");
+class SymbolOp {
+ public:
+  SymbolOp(LibPtr lib, std::string op_name)
+      : lib_(std::move(lib)), op_name_(std::move(op_name)) {}
+
+  SymbolOp(const SymbolOp &) = delete;
+  SymbolOp &operator=(const SymbolOp &) = delete;
+
+  ~SymbolOp() {
+    for (void *h : in_handles_) lib_->sym_free_(h);
+  }
+
+  template <typename T>
+  SymbolOp &SetParam(const std::string &key, const T &value) {
+    keys_.push_back(key);
+    vals_.push_back(detail::ToString(value));
+    return *this;
+  }
+
+  // Named input: routed into the op's input slot (data/weight/bias/...).
+  // The builder retains the handle, so the Symbol may be destroyed
+  // before CreateSymbol (Symbol here is move-only, not shared like
+  // mxnet-cpp's).
+  SymbolOp &SetInput(const std::string &name, const Symbol &s) {
+    lib_->Check(lib_->sym_retain_(s.handle()));
+    in_names_.push_back(name);
+    in_handles_.push_back(s.handle());
+    return *this;
+  }
+
+  // Positional input (generic multi-input ops: elemwise_add, Concat...).
+  SymbolOp &AddInput(const Symbol &s) {
+    lib_->Check(lib_->sym_retain_(s.handle()));
+    in_names_.push_back("");
+    in_handles_.push_back(s.handle());
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name = "") {
+    std::vector<const char *> k, v, n;
+    for (const auto &s : keys_) k.push_back(s.c_str());
+    for (const auto &s : vals_) v.push_back(s.c_str());
+    for (const auto &s : in_names_) n.push_back(s.c_str());
+    void *h = nullptr;
+    lib_->Check(lib_->sym_compose_(
+        op_name_.c_str(), static_cast<int>(k.size()),
+        k.empty() ? nullptr : k.data(), v.empty() ? nullptr : v.data(),
+        static_cast<int>(in_handles_.size()),
+        n.empty() ? nullptr : n.data(),
+        in_handles_.empty() ? nullptr : in_handles_.data(),
+        name.empty() ? nullptr : name.c_str(), &h));
+    return Symbol(lib_, h);
+  }
+
+ private:
+  LibPtr lib_;
+  std::string op_name_;
+  std::vector<std::string> keys_, vals_, in_names_;
+  std::vector<void *> in_handles_;
 };
 
 // Bound inference executor (reference mxnet-cpp Executor over

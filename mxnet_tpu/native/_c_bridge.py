@@ -70,19 +70,59 @@ def nd_load(fname):
     return [""] * len(data), list(data)
 
 
-def invoke(op_name, inputs, keys, vals):
-    """MXImperativeInvokeEx analog: attrs arrive as strings (the reference
-    parses them through dmlc::Parameter); literal-parse numbers/tuples/
-    bools, leave the rest as strings.  Always returns a list of outputs."""
-    from ..ops.registry import invoke as _invoke
+def _parse_attrs(keys, vals):
+    """String attrs -> Python values (the reference parses them through
+    dmlc::Parameter): literal-parse numbers/tuples/bools, leave the rest
+    as strings.  Shared by the imperative and symbolic C surfaces."""
     attrs = {}
     for k, v in zip(keys, vals):
         try:
             attrs[k] = ast.literal_eval(v)
         except (ValueError, SyntaxError):
             attrs[k] = v
-    out = _invoke(op_name, *inputs, **attrs)
+    return attrs
+
+
+def invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvokeEx analog.  Always returns a list of outputs."""
+    from ..ops.registry import invoke as _invoke
+    out = _invoke(op_name, *inputs, **_parse_attrs(keys, vals))
     return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def sym_variable(name):
+    from ..symbol.symbol import Variable
+    return Variable(name)
+
+
+def sym_compose(op_name, keys, vals, in_names, in_handles, name):
+    """MXSymbolCreateAtomicSymbol + MXSymbolCompose folded into one call
+    (reference src/c_api/c_api_symbolic.cc — bindings always run the
+    pair back to back).  Named inputs map to the op's input slots
+    (data/weight/bias...); unnamed ones compose positionally.  An
+    unknown input name raises (the reference's Compose CHECKs keyword
+    args against FListInputNames) — otherwise the caller's symbol would
+    silently be replaced by an auto-created variable."""
+    from ..ops.registry import get as _get_op
+    from ..symbol.symbol import _make_op_node, _OP_INPUT_SLOTS
+    attrs = _parse_attrs(keys, vals)
+    if name:
+        attrs["name"] = name
+    # every op accepts "data" (slotless ops route it through
+    # _make_op_node's generic data-kwarg fallback)
+    slots = _OP_INPUT_SLOTS.get(_get_op(op_name).name) or ("data",)
+    positional = []
+    for n, h in zip(in_names, in_handles):
+        if not n:
+            positional.append(h)
+        elif n in slots:
+            attrs[n] = h
+        else:
+            raise ValueError(
+                "sym_compose: %r is not an input slot of %s (slots: %s) — "
+                "compose positionally instead"
+                % (n, op_name, ", ".join(slots)))
+    return _make_op_node(op_name, positional, attrs)
 
 
 def sym_from_json(js):

@@ -297,6 +297,51 @@ int MXTpuImperativeInvoke(const char *op_name, int num_in, void **ins,
 
 // ----------------------------------------------------------------- symbol
 
+// Reference: MXSymbolCreateVariable (src/c_api/c_api_symbolic.cc).
+int MXTpuSymbolCreateVariable(const char *name, void **out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *res = bridge_call("sym_variable", Py_BuildValue("(s)", name));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+// Reference: MXSymbolCreateAtomicSymbol + MXSymbolCompose
+// (src/c_api/c_api_symbolic.cc) — one call, since every binding runs the
+// pair back to back.  in_names entries may be NULL/"" for positional
+// composition; named entries land in the op's input slots
+// (data/weight/bias/...).
+int MXTpuSymbolCompose(const char *op_name, int num_attrs,
+                       const char **keys, const char **vals, int num_in,
+                       const char **in_names, void **in_handles,
+                       const char *name, void **out) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *pk = PyList_New(num_attrs);
+  PyObject *pv = PyList_New(num_attrs);
+  for (int i = 0; i < num_attrs; ++i) {
+    PyList_SET_ITEM(pk, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pv, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *pn = PyList_New(num_in);
+  PyObject *ph = PyList_New(num_in);
+  for (int i = 0; i < num_in; ++i) {
+    const char *n = (in_names != nullptr && in_names[i] != nullptr)
+                        ? in_names[i] : "";
+    PyList_SET_ITEM(pn, i, PyUnicode_FromString(n));
+    Py_INCREF(static_cast<PyObject *>(in_handles[i]));
+    PyList_SET_ITEM(ph, i, static_cast<PyObject *>(in_handles[i]));
+  }
+  PyObject *res = bridge_call(
+      "sym_compose",
+      Py_BuildValue("(sNNNNs)", op_name, pk, pv, pn, ph,
+                    name == nullptr ? "" : name));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
 int MXTpuSymbolCreateFromJSON(const char *json, void **out) {
   mxtpu::ensure_interpreter();
   Gil gil;
@@ -343,6 +388,14 @@ int MXTpuSymbolListOutputs(void *h, char *buf, long bufsize, long *needed) {
 int MXTpuSymbolFree(void *h) {
   Gil gil;
   Py_XDECREF(static_cast<PyObject *>(h));
+  return 0;
+}
+
+// Extra strong reference on a symbol handle (host-side builders that
+// outlive their input Symbols pair this with MXTpuSymbolFree).
+int MXTpuSymbolRetain(void *h) {
+  Gil gil;
+  Py_XINCREF(static_cast<PyObject *>(h));
   return 0;
 }
 
