@@ -25,6 +25,16 @@ typedef int (*invoke_fn)(const char *, int, void **, int, const char **,
 typedef int (*waitall_fn)(void);
 typedef int (*listops_fn)(char *, long, long *);
 typedef int (*dtype_fn)(void *, int *);
+typedef int (*symvar_fn)(const char *, void **);
+typedef int (*symcompose_fn)(const char *, int, const char **,
+                             const char **, int, const char **, void **,
+                             const char *, void **);
+typedef int (*symto_fn)(void *, char *, long, long *);
+typedef int (*exbind_fn)(void *, int, const char **, const long *,
+                         const int *, void **);
+typedef int (*excopy_fn)(void *, int, const char **, void **, int *);
+typedef int (*exfwd_fn)(void *, int, const char **, void **, int, int *);
+typedef int (*exout_fn)(void *, int, void **);
 
 static err_fn p_err = NULL;
 static frombytes_fn p_frombytes = NULL;
@@ -35,6 +45,15 @@ static invoke_fn p_invoke = NULL;
 static waitall_fn p_waitall = NULL;
 static listops_fn p_listops = NULL;
 static dtype_fn p_dtype = NULL;
+static symvar_fn p_symvar = NULL;
+static symcompose_fn p_symcompose = NULL;
+static symto_fn p_symtojson = NULL;
+static free_fn p_symfree = NULL;
+static exbind_fn p_exbind = NULL;
+static excopy_fn p_excopy = NULL;
+static exfwd_fn p_exfwd = NULL;
+static exout_fn p_exout = NULL;
+static free_fn p_exfree = NULL;
 
 static void *resolve(void *lib, const char *name) {
   void *p = dlsym(lib, name);
@@ -44,6 +63,34 @@ static void *resolve(void *lib, const char *name) {
 static void need_lib(void) {
   if (p_err == NULL)
     croak("AI::MXTpu: call AI::MXTpu::load(\"libmxtpu_c_api.so\") first");
+}
+
+/* Marshal parallel name/handle AVs into Newx'd arrays (caller Safefrees
+ * both).  Croaks on a missing/0 handle — the C ABI increfs handles
+ * unconditionally, so a NULL would crash the embedded interpreter. */
+static int av_names_handles(pTHX_ AV *names, AV *handles, const char *what,
+                            const char ***out_names, void ***out_handles) {
+  int num = av_len(handles) + 1;
+  const char **cn;
+  void **ch;
+  int i;
+  Newx(cn, num ? num : 1, const char *);
+  Newx(ch, num ? num : 1, void *);
+  for (i = 0; i < num; ++i) {
+    SV **n = av_fetch(names, i, 0);
+    SV **h = av_fetch(handles, i, 0);
+    cn[i] = n ? SvPV_nolen(*n) : "";
+    ch[i] = (h != NULL && SvOK(*h)) ? INT2PTR(void *, SvUV(*h)) : NULL;
+    if (ch[i] == NULL) {
+      Safefree(cn);
+      Safefree(ch);
+      croak("AI::MXTpu: %s: entry %d has no handle (undef NDArray/"
+            "Symbol?)", what, i);
+    }
+  }
+  *out_names = cn;
+  *out_handles = ch;
+  return num;
 }
 
 MODULE = AI::MXTpu  PACKAGE = AI::MXTpu
@@ -78,8 +125,23 @@ _load(path)
       t_waitall = (waitall_fn)resolve(lib, "MXTpuWaitAll");
       t_listops = (listops_fn)resolve(lib, "MXTpuListOps");
       t_dtype = (dtype_fn)resolve(lib, "MXTpuNDArrayGetDType");
+      symvar_fn t_symvar = (symvar_fn)resolve(lib,
+                                              "MXTpuSymbolCreateVariable");
+      symcompose_fn t_symcompose =
+          (symcompose_fn)resolve(lib, "MXTpuSymbolCompose");
+      symto_fn t_symtojson = (symto_fn)resolve(lib, "MXTpuSymbolToJSON");
+      free_fn t_symfree = (free_fn)resolve(lib, "MXTpuSymbolFree");
+      exbind_fn t_exbind = (exbind_fn)resolve(lib,
+                                              "MXTpuExecutorSimpleBind");
+      excopy_fn t_excopy = (excopy_fn)resolve(lib,
+                                              "MXTpuExecutorCopyParams");
+      exfwd_fn t_exfwd = (exfwd_fn)resolve(lib, "MXTpuExecutorForward");
+      exout_fn t_exout = (exout_fn)resolve(lib, "MXTpuExecutorOutput");
+      free_fn t_exfree = (free_fn)resolve(lib, "MXTpuExecutorFree");
       if (!t_err || !t_frombytes || !t_free || !t_shape || !t_data ||
-          !t_invoke || !t_waitall || !t_listops || !t_dtype) {
+          !t_invoke || !t_waitall || !t_listops || !t_dtype ||
+          !t_symvar || !t_symcompose || !t_symtojson || !t_symfree ||
+          !t_exbind || !t_excopy || !t_exfwd || !t_exout || !t_exfree) {
         dlclose(lib);
         croak("AI::MXTpu: %s is not a complete mxtpu C ABI library",
               path);
@@ -93,6 +155,15 @@ _load(path)
       p_waitall = t_waitall;
       p_listops = t_listops;
       p_dtype = t_dtype;
+      p_symvar = t_symvar;
+      p_symcompose = t_symcompose;
+      p_symtojson = t_symtojson;
+      p_symfree = t_symfree;
+      p_exbind = t_exbind;
+      p_excopy = t_excopy;
+      p_exfwd = t_exfwd;
+      p_exout = t_exout;
+      p_exfree = t_exfree;
       RETVAL = 1;
     }
   OUTPUT:
@@ -223,6 +294,189 @@ _invoke(op, handles, keys, vals)
     }
   OUTPUT:
     RETVAL
+
+UV
+_sym_variable(name)
+    const char *name
+  CODE:
+    {
+      void *h = NULL;
+      need_lib();
+      if (p_symvar(name, &h) != 0)
+        croak("AI::MXTpu: Variable failed: %s", p_err());
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+UV
+_sym_compose(op, keys, vals, in_names, in_handles, name)
+    const char *op
+    AV *keys
+    AV *vals
+    AV *in_names
+    AV *in_handles
+    const char *name
+  CODE:
+    {
+      int nattr, nin, i, rc;
+      const char **ck;
+      const char **cv;
+      const char **cn;
+      void **ch;
+      void *h = NULL;
+      need_lib();
+      /* handle marshalling first: it is the only step that can croak,
+         so the attr arrays below cannot leak */
+      nin = av_names_handles(aTHX_ in_names, in_handles, "compose",
+                             &cn, &ch);
+      nattr = av_len(keys) + 1;
+      Newx(ck, nattr ? nattr : 1, const char *);
+      Newx(cv, nattr ? nattr : 1, const char *);
+      for (i = 0; i < nattr; ++i) {
+        SV **k = av_fetch(keys, i, 0);
+        SV **v = av_fetch(vals, i, 0);
+        ck[i] = k ? SvPV_nolen(*k) : "";
+        cv[i] = v ? SvPV_nolen(*v) : "";
+      }
+      rc = p_symcompose(op, nattr, ck, cv, nin, cn, ch,
+                        name[0] ? name : NULL, &h);
+      Safefree(ck);
+      Safefree(cv);
+      Safefree(cn);
+      Safefree(ch);
+      if (rc != 0)
+        croak("AI::MXTpu: compose %s failed: %s", op, p_err());
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+SV *
+_sym_tojson(h)
+    UV h
+  CODE:
+    {
+      long needed = 0;
+      char *buf;
+      need_lib();
+      if (p_symtojson(INT2PTR(void *, h), NULL, 0, &needed) != 0)
+        croak("AI::MXTpu: tojson failed: %s", p_err());
+      Newx(buf, needed, char);
+      if (p_symtojson(INT2PTR(void *, h), buf, needed, &needed) != 0) {
+        Safefree(buf);
+        croak("AI::MXTpu: tojson failed: %s", p_err());
+      }
+      RETVAL = newSVpv(buf, 0);
+      Safefree(buf);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_sym_free(h)
+    UV h
+  CODE:
+    if (p_symfree != NULL) p_symfree(INT2PTR(void *, h));
+
+UV
+_ex_bind(sym, names, shapes)
+    UV sym
+    AV *names
+    AV *shapes
+  CODE:
+    {
+      /* shapes: AV of AVs; flattened with per-input ndims as the C
+         surface expects */
+      int num, i, j;
+      const char *cn[16];
+      long flat[64];
+      int nds[16];
+      int off = 0;
+      void *h = NULL;
+      need_lib();
+      num = av_len(names) + 1;
+      if (num > 16) croak("AI::MXTpu: too many inputs");
+      for (i = 0; i < num; ++i) {
+        SV **n = av_fetch(names, i, 0);
+        SV **s = av_fetch(shapes, i, 0);
+        AV *sh;
+        cn[i] = n ? SvPV_nolen(*n) : "";
+        if (!s || !SvROK(*s) || SvTYPE(SvRV(*s)) != SVt_PVAV)
+          croak("AI::MXTpu: shapes must be arrayrefs");
+        sh = (AV *)SvRV(*s);
+        nds[i] = av_len(sh) + 1;
+        if (off + nds[i] > 64) croak("AI::MXTpu: shape overflow");
+        for (j = 0; j < nds[i]; ++j) {
+          SV **d = av_fetch(sh, j, 0);
+          flat[off++] = d ? (long)SvIV(*d) : 0;
+        }
+      }
+      if (p_exbind(INT2PTR(void *, sym), num, cn, flat, nds, &h) != 0)
+        croak("AI::MXTpu: bind failed: %s", p_err());
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+int
+_ex_copy_params(ex, names, handles)
+    UV ex
+    AV *names
+    AV *handles
+  CODE:
+    {
+      int num, matched = 0, rc;
+      const char **cn;
+      void **ch;
+      need_lib();
+      num = av_names_handles(aTHX_ names, handles, "copy_params",
+                             &cn, &ch);
+      rc = p_excopy(INT2PTR(void *, ex), num, cn, ch, &matched);
+      Safefree(cn);
+      Safefree(ch);
+      if (rc != 0)
+        croak("AI::MXTpu: copy_params failed: %s", p_err());
+      RETVAL = matched;
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_ex_forward(ex, names, handles)
+    UV ex
+    AV *names
+    AV *handles
+  CODE:
+    {
+      int num, i, nout = 0, rc;
+      const char **cn;
+      void **ch;
+      need_lib();
+      num = av_names_handles(aTHX_ names, handles, "forward",
+                             &cn, &ch);
+      rc = p_exfwd(INT2PTR(void *, ex), num, cn, ch, 0, &nout);
+      Safefree(cn);
+      Safefree(ch);
+      if (rc != 0)
+        croak("AI::MXTpu: forward failed: %s", p_err());
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < nout; ++i) {
+        void *out = NULL;
+        if (p_exout(INT2PTR(void *, ex), i, &out) != 0)
+          croak("AI::MXTpu: output %d failed: %s", i, p_err());
+        av_push(RETVAL, newSVuv(PTR2UV(out)));
+      }
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_ex_free(h)
+    UV h
+  CODE:
+    if (p_exfree != NULL) p_exfree(INT2PTR(void *, h));
 
 int
 _wait_all()

@@ -28,5 +28,26 @@ my ($fc) = AI::MXTpu::invoke("FullyConnected",
 die "bad fc shape" unless "@{ $fc->shape }" eq "2 4";
 
 die "too few ops" unless AI::MXTpu::num_ops() > 500;
+
+# graph composition + executor (AI::MXNet::Symbol analog):
+# data -> FC(3->2, no bias) -> relu, identity-ish weights, exact values
+my $data = AI::MXTpu::Symbol->variable("data");
+my $fc_sym = AI::MXTpu::Symbol->create("FullyConnected",
+    { num_hidden => 2, no_bias => "True" }, { data => $data }, "fc1");
+my $act = AI::MXTpu::Symbol->create("Activation",
+    { act_type => "relu" }, { data => $fc_sym }, "relu1");
+die "bad json" unless $act->tojson =~ /fc1_weight/;
+
+my $ex = $act->bind({ data => [2, 3] });
+my $w = AI::MXTpu::NDArray->new([1, 0, 0, 0, -1, 0], [2, 3]);
+die "param miss" unless $ex->copy_params({ fc1_weight => $w }) == 1;
+my $x = AI::MXTpu::NDArray->new([1, 2, 3, -4, 5, 6], [2, 3]);
+my ($out) = $ex->forward({ data => $x });
+# rows: [1,2,3] -> [1,-2] -> relu [1,0]; [-4,5,6] -> [-4,-5] -> [0,0]
+my @o = @{ $out->values };
+die "bad composed forward: @o"
+    unless $o[0] == 1 && $o[1] == 0 && $o[2] == 0 && $o[3] == 0;
+print "perl composed net forward: @o\n";
+
 AI::MXTpu::wait_all() == 0 or die "wait_all failed";
 print "PERL_BINDING_OK\n";

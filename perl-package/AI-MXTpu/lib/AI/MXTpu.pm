@@ -71,4 +71,98 @@ sub DESTROY {
     $self->{handle} = 0;
 }
 
+package AI::MXTpu::Symbol;
+
+# Graph building from Perl (reference analog: AI::MXNet::Symbol).
+#
+#   my $data = AI::MXTpu::Symbol->variable("data");
+#   my $fc = AI::MXTpu::Symbol->create("FullyConnected",
+#       { num_hidden => 2, no_bias => "True" },
+#       { data => $data }, "fc1");
+#   my $json = $fc->tojson;
+
+use strict;
+use warnings;
+
+sub variable {
+    my ($class, $name) = @_;
+    return bless { handle => AI::MXTpu::_sym_variable($name) }, $class;
+}
+
+sub create {
+    my ($class, $op, $attrs, $inputs, $name) = @_;
+    my (@k, @v, @in_names, @in_handles);
+    for my $key (sort keys %{ $attrs || {} }) {
+        push @k, $key;
+        push @v, "" . $attrs->{$key};
+    }
+    if (ref($inputs) eq 'HASH') {
+        for my $key (sort keys %$inputs) {
+            push @in_names,   $key;
+            push @in_handles, $inputs->{$key}{handle};
+        }
+    }
+    else {    # arrayref: positional composition
+        for my $s (@{ $inputs || [] }) {
+            push @in_names,   "";
+            push @in_handles, $s->{handle};
+        }
+    }
+    my $h = AI::MXTpu::_sym_compose($op, \@k, \@v, \@in_names,
+                                    \@in_handles, $name // "");
+    return bless { handle => $h }, $class;
+}
+
+sub tojson { my ($self) = @_; return AI::MXTpu::_sym_tojson($self->{handle}) }
+
+sub bind {
+    my ($self, $shapes) = @_;    # { name => [dims...] }
+    my (@names, @dims);
+    for my $key (sort keys %{ $shapes || {} }) {
+        push @names, $key;
+        push @dims,  $shapes->{$key};
+    }
+    my $h = AI::MXTpu::_ex_bind($self->{handle}, \@names, \@dims);
+    return bless { handle => $h }, 'AI::MXTpu::Executor';
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_sym_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+package AI::MXTpu::Executor;
+
+use strict;
+use warnings;
+
+sub copy_params {
+    my ($self, $params) = @_;    # { name => NDArray }
+    my (@names, @handles);
+    for my $key (sort keys %{ $params || {} }) {
+        push @names,   $key;
+        push @handles, $params->{$key}{handle};
+    }
+    return AI::MXTpu::_ex_copy_params($self->{handle}, \@names, \@handles);
+}
+
+sub forward {
+    my ($self, $feeds) = @_;     # { name => NDArray }
+    my (@names, @handles);
+    for my $key (sort keys %{ $feeds || {} }) {
+        push @names,   $key;
+        push @handles, $feeds->{$key}{handle};
+    }
+    my $outs =
+        AI::MXTpu::_ex_forward($self->{handle}, \@names, \@handles);
+    return map { AI::MXTpu::NDArray->_adopt($_) } @$outs;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_ex_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
 1;
