@@ -444,3 +444,66 @@ def test_perl_binding(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr[-1200:])
     assert "PERL_BINDING_OK" in r.stdout
     assert "add: 11 22 33 44 55 66" in r.stdout
+
+
+def test_c_api_symbol_compose_contract(tmp_path):
+    """The compose surface's error contract through raw ctypes: named
+    inputs validate against the op's slots (unknown names FAIL instead of
+    silently auto-creating variables), tojson round-trips, and retain
+    balances free so shared subexpressions survive a builder's release."""
+    import ctypes
+    lib_path = os.path.join(ROOT, "mxnet_tpu", "native",
+                            "libmxtpu_c_api.so")
+    if not os.path.exists(lib_path):
+        import subprocess
+        subprocess.run(["make", "-C", os.path.join(ROOT, "src", "native"),
+                        "core_api"], check=True, capture_output=True)
+    lib = ctypes.CDLL(lib_path)
+    lib.MXTpuCGetLastError.restype = ctypes.c_char_p
+
+    var = ctypes.c_void_p()
+    assert lib.MXTpuSymbolCreateVariable(b"data", ctypes.byref(var)) == 0
+
+    def compose(op, attrs, in_names, in_handles, name):
+        keys = (ctypes.c_char_p * max(1, len(attrs)))(
+            *[k.encode() for k in attrs])
+        vals = (ctypes.c_char_p * max(1, len(attrs)))(
+            *[str(v).encode() for v in attrs.values()])
+        names = (ctypes.c_char_p * max(1, len(in_handles)))(
+            *[n.encode() for n in in_names])
+        hs = (ctypes.c_void_p * max(1, len(in_handles)))(*in_handles)
+        out = ctypes.c_void_p()
+        rc = lib.MXTpuSymbolCompose(op, len(attrs), keys, vals,
+                                    len(in_handles), names, hs,
+                                    name, ctypes.byref(out))
+        return rc, out
+
+    # happy path: named slot input
+    rc, fc = compose(b"FullyConnected",
+                     {"num_hidden": 4, "no_bias": "True"},
+                     ["data"], [var.value], b"fc1")
+    assert rc == 0, lib.MXTpuCGetLastError()
+
+    # unknown input name: hard error naming the slots, no silent variable
+    rc, _ = compose(b"FullyConnected", {"num_hidden": 4},
+                    ["weights"], [var.value], b"bad")
+    assert rc != 0
+    assert b"weights" in lib.MXTpuCGetLastError()
+
+    # tojson sees the composed graph
+    needed = ctypes.c_long()
+    assert lib.MXTpuSymbolToJSON(fc, None, 0, ctypes.byref(needed)) == 0
+    buf = ctypes.create_string_buffer(needed.value)
+    assert lib.MXTpuSymbolToJSON(fc, buf, needed, ctypes.byref(needed)) == 0
+    assert b"fc1_weight" in buf.value
+
+    # retain/free balance: an extra retain keeps the handle alive through
+    # one free (the SymbolOp builder's lifetime pattern)
+    assert lib.MXTpuSymbolRetain(var) == 0
+    assert lib.MXTpuSymbolFree(var) == 0
+    rc, relu = compose(b"Activation", {"act_type": "relu"},
+                       ["data"], [var.value], b"relu1")
+    assert rc == 0, lib.MXTpuCGetLastError()
+    lib.MXTpuSymbolFree(relu)
+    lib.MXTpuSymbolFree(fc)
+    lib.MXTpuSymbolFree(var)
