@@ -266,3 +266,55 @@ def test_bucketing_module_with_rnn_cells():
     assert len(mod._buckets) == 2
     assert metric.get()[1] < 6.0, \
         "perplexity did not improve: %s" % metric.get()[1]
+
+
+def test_bucket_iter_time_major_layout():
+    """layout='TN' serves (T, B) batches with TN descs (reference
+    BucketSentenceIter major_axis handling)."""
+    sentences = [[1, 2, 3], [2, 3, 4], [3, 4, 1], [4, 1, 2]]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=2, buckets=[3],
+                                   invalid_label=0, layout="TN")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (3, 2)
+    assert batch.provide_data[0].layout == "TN"
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_allclose(l[:-1], d[1:])
+
+
+def test_fused_next_states_match_unfused():
+    """get_next_state=True: the fused cell's final (h, c) equal the
+    unfused stack's final states given shared weights."""
+    T, B, I, H = 4, 3, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="s_",
+                                get_next_state=True)
+    fo, fstates = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                               merge_outputs=True)
+    assert len(fstates) == 2
+    grp = mx.sym.Group([fo] + list(fstates))
+    fex = grp.simple_bind(data=(B, T, I))
+    rng = np.random.RandomState(11)
+    blob = rng.uniform(-0.4, 0.4,
+                       fex.arg_dict["s_parameters"].shape).astype("f")
+    data = rng.randn(B, T, I).astype("f")
+    fex.arg_dict["s_parameters"][:] = blob
+    fex.arg_dict["data"][:] = data
+    fout, fh, fc = [o.asnumpy() for o in fex.forward()]
+    # fused h_n/c_n carry the (L*D, B, H) layer axis
+    assert fh.shape == (1, B, H) and fc.shape == (1, B, H)
+
+    stack = fused.unfuse()
+    so, sstates = stack.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                               merge_outputs=True)
+    sgrp = mx.sym.Group([so] + list(sstates))
+    sex = sgrp.simple_bind(data=(B, T, I))
+    shared = stack.pack_weights(
+        fused.unpack_weights({"s_parameters": mx.nd.array(blob)}))
+    sex.arg_dict["data"][:] = data
+    for n, arr in shared.items():
+        if n in sex.arg_dict:
+            sex.arg_dict[n][:] = arr.asnumpy()
+    sout, sh, sc = [o.asnumpy() for o in sex.forward()]
+    np.testing.assert_allclose(fout, sout, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(fh[0], sh, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(fc[0], sc, rtol=2e-5, atol=2e-6)
