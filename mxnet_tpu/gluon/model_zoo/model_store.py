@@ -1,0 +1,68 @@
+"""Local pretrained-weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py — get_model_file/purge over
+an S3-backed cache at ``~/.mxnet/models``).
+
+This build targets air-gapped hosts (zero egress), so the DOWNLOAD half
+of the reference contract is replaced by a documented local-provisioning
+step: place ``{model_name}.params`` (or the reference's own
+``{model_name}-{sha1[:8]}.params`` download naming) under the cache root
+and ``pretrained=True`` picks it up.  Files in the reference's binary
+.params wire format load as-is (mxnet_tpu.compat parses them), so
+weights fetched once on a connected machine with Apache MXNet transfer
+directly.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge", "load_pretrained"]
+
+
+def _root(root=None):
+    if root is None:
+        root = os.path.join(
+            os.environ.get("MXNET_HOME",
+                           os.path.join(os.path.expanduser("~"), ".mxnet")),
+            "models")
+    return os.path.expanduser(root)
+
+
+def get_model_file(name, root=None):
+    """Path of the locally-provisioned parameter file for ``name``.
+
+    Accepts ``{name}.params`` or the reference's hashed download naming
+    ``{name}-XXXXXXXX.params``.  Raises with provisioning instructions
+    when absent (the reference would download here).
+    """
+    root = _root(root)
+    exact = os.path.join(root, "%s.params" % name)
+    if os.path.exists(exact):
+        return exact
+    if os.path.isdir(root):
+        hashed = sorted(f for f in os.listdir(root)
+                        if f.startswith("%s-" % name)
+                        and f.endswith(".params"))
+        if hashed:
+            return os.path.join(root, hashed[0])
+    raise RuntimeError(
+        "Pretrained weights for %r not found under %s and this host has "
+        "no network egress.  Provision them locally: copy %s.params "
+        "(this framework's format, or the reference's binary .params — "
+        "both load) into that directory." % (name, root, name))
+
+
+def purge(root=None):
+    """Delete every cached parameter file (reference model_store.purge)."""
+    root = _root(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))
+
+
+def load_pretrained(net, name, root=None, ctx=None):
+    """Shared ``pretrained=True`` path for the model-zoo factories: load
+    the local store's weights into ``net`` (by-name, dtype-cast)."""
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net

@@ -46,7 +46,6 @@ def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     """AlexNet factory (reference: vision/alexnet.py:81)."""
     net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root, ctx)
     return net

@@ -99,9 +99,8 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "densenet%d" % num_layers, root, ctx)
     return net
 
 

@@ -153,7 +153,6 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", root, ctx)
     return net

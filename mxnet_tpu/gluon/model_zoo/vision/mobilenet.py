@@ -126,9 +126,8 @@ class MobileNetV2(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenet%s" % str(multiplier), root, ctx)
     return net
 
 
@@ -136,9 +135,8 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenetv2_%s" % str(multiplier), root, ctx)
     return net
 
 

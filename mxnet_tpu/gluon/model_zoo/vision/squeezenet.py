@@ -103,9 +103,8 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights unavailable: no network egress; load local "
-            "params with net.load_parameters() instead.")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet%s" % version, root, ctx)
     return net
 
 

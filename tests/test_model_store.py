@@ -1,0 +1,52 @@
+"""model_zoo.model_store — the local pretrained-weight cache (reference:
+python/mxnet/gluon/model_zoo/model_store.py, with the download half
+replaced by documented local provisioning on this air-gapped target)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def test_pretrained_loads_from_local_store(tmp_path):
+    src = vision.squeezenet1_0()
+    src.initialize(mx.init.Xavier())
+    src(mx.nd.array(np.zeros((1, 3, 224, 224), np.float32)))  # shapes
+    src.save_parameters(str(tmp_path / "squeezenet1.0.params"))
+
+    net = vision.squeezenet1_0(pretrained=True, root=str(tmp_path))
+    # the two instances carry different auto name scopes (squeezenet0_ vs
+    # squeezenet1_); load_parameters matches on the scope-stripped names
+    want = {k.split("_", 1)[1]: v for k, v in
+            src.collect_params().items()}
+    got = {k.split("_", 1)[1]: v for k, v in
+           net.collect_params().items()}
+    assert set(want) == set(got)
+    for name in want:
+        np.testing.assert_array_equal(got[name].data().asnumpy(),
+                                      want[name].data().asnumpy())
+
+
+def test_hashed_download_naming_accepted(tmp_path):
+    # the reference's cache writes {name}-{sha1[:8]}.params
+    src = vision.squeezenet1_0()
+    src.initialize(mx.init.Xavier())
+    src(mx.nd.array(np.zeros((1, 3, 224, 224), np.float32)))
+    src.save_parameters(str(tmp_path / "squeezenet1.0-0123abcd.params"))
+    path = model_store.get_model_file("squeezenet1.0", root=str(tmp_path))
+    assert path.endswith("squeezenet1.0-0123abcd.params")
+
+
+def test_missing_weights_error_names_the_root(tmp_path):
+    with pytest.raises(RuntimeError, match="Provision them locally"):
+        vision.alexnet(pretrained=True, root=str(tmp_path))
+
+
+def test_purge(tmp_path):
+    (tmp_path / "resnet18_v1.params").write_bytes(b"x")
+    (tmp_path / "keepme.txt").write_bytes(b"x")
+    model_store.purge(root=str(tmp_path))
+    assert not (tmp_path / "resnet18_v1.params").exists()
+    assert (tmp_path / "keepme.txt").exists()
