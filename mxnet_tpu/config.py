@@ -112,6 +112,12 @@ register_knob(
     "Reference bulking segment size; informational on TPU — one jitted "
     "step is a single fused program, bulking has no residual role.")
 
+register_knob(
+    "model_store.root", "MXNET_HOME", str, "",
+    "root of the local pretrained-weight cache (models live under "
+    "<root>/models); empty = ~/.mxnet.  The reference's env var, honored "
+    "by gluon.model_zoo.model_store on this zero-egress target.")
+
 # distributed rendezvous (parallel/__init__.py)
 register_knob(
     "dist.coordinator", "MXTPU_COORDINATOR", str, "",

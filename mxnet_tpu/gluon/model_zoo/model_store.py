@@ -20,10 +20,10 @@ __all__ = ["get_model_file", "purge", "load_pretrained"]
 
 def _root(root=None):
     if root is None:
-        root = os.path.join(
-            os.environ.get("MXNET_HOME",
-                           os.path.join(os.path.expanduser("~"), ".mxnet")),
-            "models")
+        from ... import config
+        base = config.get("model_store.root") or \
+            os.path.join(os.path.expanduser("~"), ".mxnet")
+        root = os.path.join(base, "models")
     return os.path.expanduser(root)
 
 
