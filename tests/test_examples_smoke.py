@@ -61,3 +61,15 @@ def test_benchmark_score_example():
                "--batch-sizes", "2", "--iters", "2",
                "--image-shape", "3,32,32", timeout=900)
     assert "img/s" in out and "resnet18_v1" in out
+
+
+def test_bandwidth_tool():
+    out = _run("tools/bandwidth.py", "--network", "squeezenet1.0",
+               "--num-batches", "2")
+    assert "result check OK" in out
+
+
+def test_bandwidth_tool_2bit():
+    out = _run("tools/bandwidth.py", "--network", "squeezenet1.0",
+               "--num-batches", "1", "--gc-type", "2bit")
+    assert "result check OK" in out
