@@ -318,3 +318,55 @@ def test_fused_next_states_match_unfused():
     np.testing.assert_allclose(fout, sout, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(fh[0], sh, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(fc[0], sc, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("kind", ["rnn", "lstm", "gru"])
+def test_symbolic_cell_matches_gluon_cell(kind):
+    """Cross-stack consistency (check_consistency spirit): the symbolic
+    mx.rnn cell and the eager gluon.rnn cell compute identical steps
+    given identical gate-stacked weights."""
+    B, I, H = 3, 4, 6
+    rng = np.random.RandomState(9)
+    gmul = {"rnn": 1, "lstm": 4, "gru": 3}[kind]
+    weights = {"i2h_weight": rng.randn(gmul * H, I).astype("f"),
+               "i2h_bias": rng.randn(gmul * H).astype("f"),
+               "h2h_weight": rng.randn(gmul * H, H).astype("f"),
+               "h2h_bias": rng.randn(gmul * H).astype("f")}
+    x = rng.randn(B, I).astype("f")
+    h0 = rng.randn(B, H).astype("f")
+    c0 = rng.randn(B, H).astype("f")
+
+    sym_cell = {"rnn": mx.rnn.RNNCell,
+                "lstm": mx.rnn.LSTMCell,
+                "gru": mx.rnn.GRUCell}[kind](H, prefix="p_")
+    states = [mx.sym.Variable("h0")]
+    if kind == "lstm":
+        states.append(mx.sym.Variable("c0"))
+    out, _ = sym_cell(mx.sym.Variable("x"), states)
+    shapes = {"x": (B, I), "h0": (B, H)}
+    if kind == "lstm":
+        shapes["c0"] = (B, H)
+    ex = out.simple_bind(**shapes)
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["h0"][:] = h0
+    if kind == "lstm":
+        ex.arg_dict["c0"][:] = c0
+    for name, v in weights.items():
+        ex.arg_dict["p_" + name][:] = v
+    sym_out = ex.forward()[0].asnumpy()
+
+    glu_cell = {"rnn": mx.gluon.rnn.RNNCell,
+                "lstm": mx.gluon.rnn.LSTMCell,
+                "gru": mx.gluon.rnn.GRUCell}[kind](H, input_size=I)
+    glu_cell.initialize()
+    gstates = [mx.nd.array(h0)]
+    if kind == "lstm":
+        gstates.append(mx.nd.array(c0))
+    glu_cell(mx.nd.array(x), gstates)  # materialize params
+    params = glu_cell.collect_params()
+    for pname, p in params.items():
+        suffix = pname.split("_", 1)[1]
+        p.set_data(mx.nd.array(weights[suffix]))
+    glu_out, _ = glu_cell(mx.nd.array(x), gstates)
+    np.testing.assert_allclose(sym_out, glu_out.asnumpy(), rtol=2e-5,
+                               atol=2e-6)
