@@ -95,6 +95,17 @@ int main(int argc, char **argv) {
       std::printf("composed args: %zu\n", args.size());
       if (args != std::vector<std::string>({"data", "fc1_weight"}))
         return 1;
+      /* shape inference sizes the parameter before any bind */
+      auto shapes = act.InferShape({{"data", {2, 3}}});
+      if (shapes.at("arg fc1_weight") != std::vector<long>({2, 3}))
+        return 1;
+      bool out_ok = false;
+      for (const auto &kv : shapes) {
+        if (kv.first.rfind("out ", 0) == 0 &&
+            kv.second == std::vector<long>({2, 2}))
+          out_ok = true;
+      }
+      if (!out_ok) return 1;
       auto ex = mxtpu::Executor::SimpleBind(act, {{"data", {2, 3}}});
       mxtpu::NDArray w(lib, {1, 0, 0, 0, -1, 0}, {2, 3});
       if (ex.CopyParams({{"fc1_weight", &w}}) != 1) return 1;

@@ -23,6 +23,7 @@
 #include <dlfcn.h>
 
 #include <cstring>
+#include <map>
 #include <memory>
 #include <stdexcept>
 #include <string>
@@ -70,6 +71,8 @@ class Lib {
   using symcompose_fn = int (*)(const char *, int, const char **,
                                 const char **, int, const char **, void **,
                                 const char *, void **);
+  using syminfer_fn = int (*)(void *, int, const char **, const long *,
+                              const int *, char *, long, long *);
 
   static std::shared_ptr<Lib> Load(const std::string &path) {
     auto lib = std::shared_ptr<Lib>(new Lib());
@@ -112,6 +115,7 @@ class Lib {
   symvar_fn sym_variable_ = nullptr;
   symcompose_fn sym_compose_ = nullptr;
   mark_fn sym_retain_ = nullptr;
+  syminfer_fn sym_infer_shape_ = nullptr;
   symto_fn sym_to_json_ = nullptr;
   symto_fn sym_list_arguments_ = nullptr;
   symto_fn sym_list_outputs_ = nullptr;
@@ -156,6 +160,7 @@ class Lib {
     Sym(&sym_variable_, "MXTpuSymbolCreateVariable");
     Sym(&sym_compose_, "MXTpuSymbolCompose");
     Sym(&sym_retain_, "MXTpuSymbolRetain");
+    Sym(&sym_infer_shape_, "MXTpuSymbolInferShape");
     Sym(&sym_to_json_, "MXTpuSymbolToJSON");
     Sym(&sym_list_arguments_, "MXTpuSymbolListArguments");
     Sym(&sym_list_outputs_, "MXTpuSymbolListOutputs");
@@ -440,6 +445,51 @@ class Symbol {
 
   std::vector<std::string> ListOutputs() const {
     return SplitLines(StrCall(lib_->sym_list_outputs_));
+  }
+
+  // Reference: Symbol.infer_shape / MXSymbolInferShape.  Returns
+  // "arg|out|aux name" -> dims for everything inference could solve
+  // (unknown entries are omitted).
+  std::map<std::string, std::vector<long>> InferShape(
+      const std::vector<std::pair<std::string, std::vector<long>>>
+          &known) const {
+    std::vector<const char *> names;
+    std::vector<long> flat;
+    std::vector<int> nds;
+    for (const auto &kv : known) {
+      names.push_back(kv.first.c_str());
+      nds.push_back(static_cast<int>(kv.second.size()));
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+    }
+    void *h = handle_;
+    const Lib *lib = lib_.get();
+    auto *np = names.empty() ? nullptr : names.data();
+    auto *fp = flat.empty() ? nullptr : flat.data();
+    auto *dp = nds.empty() ? nullptr : nds.data();
+    int num = static_cast<int>(names.size());
+    std::string out = detail::QueryString(
+        lib_, [lib, h, num, np, fp, dp](char *buf, long n, long *need) {
+          return lib->sym_infer_shape_(h, num, np, fp, dp, buf, n, need);
+        });
+    std::map<std::string, std::vector<long>> result;
+    for (const auto &line : detail::SplitLines(out)) {
+      size_t colon = line.rfind(':');
+      if (colon == std::string::npos) continue;
+      std::string dims_s = line.substr(colon + 1);
+      if (dims_s == "?") continue;
+      std::vector<long> dims;
+      size_t start = 0;
+      while (start <= dims_s.size() && !dims_s.empty()) {
+        size_t comma = dims_s.find(',', start);
+        dims.push_back(std::stol(dims_s.substr(
+            start, comma == std::string::npos ? std::string::npos
+                                              : comma - start)));
+        if (comma == std::string::npos) break;
+        start = comma + 1;
+      }
+      result[line.substr(0, colon)] = dims;
+    }
+    return result;
   }
 
   void *handle() const { return handle_; }

@@ -125,6 +125,25 @@ def sym_compose(op_name, keys, vals, in_names, in_handles, name):
     return _make_op_node(op_name, positional, attrs)
 
 
+def sym_infer_shape(sym, names, shapes):
+    """MXSymbolInferShape analog: known input shapes in, newline-joined
+    ``name:dims`` lines out (args then outputs, '?' for unknown)."""
+    shape_map = {n: tuple(int(d) for d in s)
+                 for n, s in zip(names, shapes)}
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shape_map)
+    lines = []
+    for name, shp in zip(sym.list_arguments(), arg_shapes):
+        lines.append("arg %s:%s" % (name, "?" if shp is None else
+                                    ",".join(str(d) for d in shp)))
+    for name, shp in zip(sym.list_outputs(), out_shapes):
+        lines.append("out %s:%s" % (name, "?" if shp is None else
+                                    ",".join(str(d) for d in shp)))
+    for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
+        lines.append("aux %s:%s" % (name, "?" if shp is None else
+                                    ",".join(str(d) for d in shp)))
+    return "\n".join(lines)
+
+
 def sym_from_json(js):
     from ..symbol.symbol import load_json
     return load_json(js)

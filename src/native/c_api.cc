@@ -342,6 +342,35 @@ int MXTpuSymbolCompose(const char *op_name, int num_attrs,
   return 0;
 }
 
+// Reference: MXSymbolInferShape (src/c_api/c_api_symbolic.cc) — known
+// input shapes in (flattened dims + per-input ndims), newline-joined
+// "arg|out|aux name:d0,d1,..." lines out ('?' for unknown).
+int MXTpuSymbolInferShape(void *sym, int num, const char **names,
+                          const long *shapes_flat, const int *ndims,
+                          char *buf, long bufsize, long *needed) {
+  mxtpu::ensure_interpreter();
+  Gil gil;
+  PyObject *pn = PyList_New(num);
+  PyObject *ps = PyList_New(num);
+  long off = 0;
+  for (int i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pn, i, PyUnicode_FromString(names[i]));
+    PyObject *dims = PyList_New(ndims[i]);
+    for (int j = 0; j < ndims[i]; ++j) {
+      PyList_SET_ITEM(dims, j, PyLong_FromLong(shapes_flat[off + j]));
+    }
+    off += ndims[i];
+    PyList_SET_ITEM(ps, i, dims);
+  }
+  PyObject *res = bridge_call(
+      "sym_infer_shape",
+      Py_BuildValue("(ONN)", static_cast<PyObject *>(sym), pn, ps));
+  if (res == nullptr) return -1;
+  int rc = str_out(res, buf, bufsize, needed);
+  Py_DECREF(res);
+  return rc;
+}
+
 int MXTpuSymbolCreateFromJSON(const char *json, void **out) {
   mxtpu::ensure_interpreter();
   Gil gil;
