@@ -303,8 +303,17 @@ def run_bench(runs_out):
             import mxnet_tpu.config as _cfg
             _cfg.set("conv.internal_layout", "native")
             _cfg.set("conv.weights_layout", "ref")
-    # inference config last and fenced: training numbers are the headline,
-    # so neither a watchdog kill nor an exception here may cost them
+    # secondary runs are fenced: the ResNet training numbers are the
+    # headline, so neither a watchdog kill nor an exception here may cost
+    # them.  module_train measures the symbolic Module's FUSED train step
+    # against its eager twin (mode recorded per run, samples_s key keeps it
+    # out of the img_s headline pick).
+    try:
+        module_train_config(runs_out, 40 if on_tpu else 20,
+                            10 if on_tpu else 5)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "module_train",
+                         "error": "%s: %s" % (type(e).__name__, e)})
     try:
         infer_config(128 if on_tpu else 16, "bfloat16",
                      100 if on_tpu else 3)
@@ -315,6 +324,69 @@ def run_bench(runs_out):
     result = _summarize(runs_out)
     result.update(platform=platform, device_kind=kind)
     return result
+
+
+def module_train_config(runs_out, fused_iters, eager_iters):
+    """Secondary: symbolic Module.fit step throughput, fused vs eager.
+
+    The benchmark MLP (8x128, batch 64, adam) is dispatch-bound, which is
+    exactly what the fused train step eliminates — one jitted
+    fwd+bwd+update program per step vs two stage programs plus a
+    per-parameter updater loop.  PR acceptance pins fused >= 3x eager on
+    CPU; the measured pair is recorded under runs[] with mode
+    "module_train" and surfaced as module_mlp_train_throughput."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _cfg
+
+    layers, width, batch, feat = 8, 128, 64, 64
+    rng = np.random.RandomState(0)
+    X = mx.nd.array(rng.randn(batch, feat).astype(np.float32))
+    Y = mx.nd.array((rng.rand(batch) * 10).astype(np.float32))
+    batch_obj = mx.io.DataBatch([X], [Y])
+
+    def build_sym():
+        h = mx.sym.Variable("data")
+        for i in range(layers):
+            h = mx.sym.FullyConnected(h, num_hidden=width, name="fc%d" % i)
+            h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="head")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    def one_path(mode, iters):
+        import jax
+        _cfg.set("module.fused_step", "auto" if mode == "fused" else "off")
+        mod = mx.mod.Module(build_sym())
+        mod.bind([("data", (batch, feat))], [("softmax_label", (batch,))])
+        mod.init_params(mx.init.Uniform(0.05))
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 1e-3})
+        for _ in range(3):                     # compile + warm
+            mod.train_step(batch_obj)
+        sync = mod._exec.arg_dict["fc0_weight"]
+        np.asarray(sync._data)                 # forced sync (see header)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            mod.train_step(batch_obj)
+        np.asarray(sync._data)
+        dt = time.perf_counter() - t0
+        runs_out.append({
+            "mode": "module_train", "path": mode, "batch": batch,
+            "iters": iters, "mlp": "%dx%d" % (layers, width),
+            "optimizer": "adam",
+            "steps_s": round(iters / dt, 2),
+            "samples_s": round(batch * iters / dt, 2),
+        })
+        return iters / dt
+
+    try:
+        fused = one_path("fused", fused_iters)
+        eager = one_path("eager", eager_iters)
+        if eager > 0:
+            runs_out.append({"mode": "module_train", "path": "speedup",
+                             "fused_over_eager": round(fused / eager, 2)})
+    finally:
+        _cfg.set("module.fused_step", "auto")
 
 
 def _summarize(runs):
@@ -332,7 +404,20 @@ def _summarize(runs):
     train = [r for r in timed if r.get("mode") != "inference"]
     bf16 = [r for r in train if r["dtype"] == "bfloat16"]
     best = max(bf16 or train or timed, key=lambda r: r["img_s"])
-    return {
+    secondary = {}
+    mod_runs = {r.get("path"): r for r in runs
+                if r.get("mode") == "module_train"}
+    if "fused" in mod_runs:
+        secondary["module_mlp_train_throughput"] = {
+            "value": mod_runs["fused"]["samples_s"],
+            "unit": "samples/s",
+            "mlp": mod_runs["fused"]["mlp"],
+            "batch": mod_runs["fused"]["batch"],
+        }
+        if "speedup" in mod_runs:
+            secondary["module_mlp_train_throughput"]["fused_over_eager"] = \
+                mod_runs["speedup"]["fused_over_eager"]
+    return dict(secondary, **{
         "metric": "resnet50_train_throughput",
         "value": best["img_s"],
         "unit": "img/s",
@@ -345,7 +430,7 @@ def _summarize(runs):
         "runs": list(runs),
         "baseline_note": "baseline 363.69 img/s = fp32 V100 BS128 "
                          "(reference perf.md:254)",
-    }
+    })
 
 
 def main():

@@ -181,6 +181,15 @@ register_knob(
     "checkpoints store the active layout and must be reloaded under the "
     "same knob.")
 
+# symbolic Module executor (the CachedOp static_alloc analog)
+register_knob(
+    "module.fused_step", "MXTPU_MODULE_FUSED_STEP", str, "auto",
+    "symbolic Module train-step mode: auto (default — Module.fit / "
+    "forward_backward+update fuse forward, backward and the optimizer "
+    "update into ONE donated jit program per shape signature whenever the "
+    "optimizer is jit-traceable) or off (always the stage-at-a-time eager "
+    "path; also forced by NaiveEngine).  docs/PERF_NOTES.md.")
+
 # profiler (reference env_var.md:201-205)
 register_knob(
     "profiler.autostart", "MXNET_PROFILER_AUTOSTART", bool, False,

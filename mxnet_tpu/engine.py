@@ -21,7 +21,7 @@ import contextlib
 import os
 
 __all__ = ["bulk", "set_bulk_size", "engine_type", "set_engine_type",
-           "naive_engine_enabled"]
+           "naive_engine_enabled", "fused_step_allowed"]
 
 from . import config as _config
 
@@ -60,6 +60,14 @@ def set_engine_type(name):
 
 def naive_engine_enabled():
     return _ENGINE_TYPE[0] == "NaiveEngine"
+
+
+def fused_step_allowed():
+    """Whether fused single-dispatch train steps may run.  NaiveEngine's
+    contract is synchronous per-op completion (error bisection), which a
+    fused fwd+bwd+update program by definition violates — Module falls back
+    to the stage-at-a-time eager path while it is selected."""
+    return not naive_engine_enabled()
 
 
 def maybe_sync(arrays):

@@ -63,6 +63,14 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def train_step(self, data_batch):
+        """One optimization step on `data_batch` — forward_backward + update.
+        Module runs this as ONE fused jitted program when eligible (see
+        Module's PERFORMANCE NOTE); elsewhere it is the literal two-stage
+        reference sequence."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
@@ -104,8 +112,7 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             for data_batch in train_data:
-                self.forward_backward(data_batch)
-                self.update()
+                self.train_step(data_batch)
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     from ..callback import BatchEndParam

@@ -127,18 +127,39 @@ class BucketingModule(BaseModule):
         default = self._buckets[self._default_bucket_key]
         mod._optimizer = default._optimizer
         mod._updater = default._updater
+        # one fused-step state dict (per-NAME optimizer moments, update
+        # count, lr/wd upload cache) across every bucket, exactly as the
+        # eager updater is shared — a bucket switch must not reset momentum
+        mod._fused_shared = default._fused_shared
         mod.optimizer_initialized = True
 
-    def forward(self, data_batch, is_train=None):
-        assert self.binded
+    def _switch_to(self, data_batch):
+        prev = self._curr_module
         key = getattr(data_batch, "bucket_key", self._default_bucket_key)
         self.switch_bucket(key, data_batch.provide_data,
                            data_batch.provide_label)
+        if prev is not None and prev is not self._curr_module:
+            # a batch deferred on another bucket must replay before its
+            # executor state is abandoned
+            prev._flush_pending()
         if self._curr_bucket_key != self._default_bucket_key \
                 and self.params_initialized:
             # sync shared params into this bucket's executor
             arg, aux = self._buckets[self._default_bucket_key].get_params()
             self._curr_module.set_params(arg, aux)
+
+    def forward_backward(self, data_batch):
+        # delegate WHOLE pairs to the bucket Module (not forward()+
+        # backward() on self) so its fused train step can engage; each
+        # bucket's executor keeps its own compiled program, so revisiting a
+        # bucket is a cache hit, not a recompile
+        assert self.binded
+        self._switch_to(data_batch)
+        self._curr_module.forward_backward(data_batch)
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded
+        self._switch_to(data_batch)
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):

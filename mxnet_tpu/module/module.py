@@ -28,16 +28,32 @@ __all__ = ["Module"]
 class Module(BaseModule):
     """Symbolic Module (reference: python/mxnet/module/module.py:40).
 
-    PERFORMANCE NOTE — read before benchmarking with Module.fit: this path
-    keeps the reference's per-batch structure (forward, backward, then a
-    per-parameter optimizer update outside jit), which costs one host
-    round-trip per stage per batch.  It is numerically equivalent to
-    ``mx.parallel.SPMDTrainer`` (tested:
-    tests/test_parallel.py::test_module_vs_spmd_trainer_equivalence) but an
-    order of magnitude slower on TPU: SPMDTrainer fuses
-    forward+backward+allreduce+update into ONE jitted step and is the
-    intended hot path for every BASELINE.json config.  Use Module for
-    script parity and debugging; train with SPMDTrainer.
+    PERFORMANCE NOTE — the train step is FUSED by default.  When the bound
+    optimizer is jit-traceable (``Optimizer.jit_safe``), ``fit`` /
+    ``forward_backward``+``update`` dispatch ONE jitted XLA program per
+    (shape signature) carrying forward + backward + the optimizer update —
+    the CachedOp ``static_alloc=True`` analog — with parameters and
+    optimizer state donated on accelerator backends so the update happens
+    in place in HBM.  ``forward_backward`` defers the batch and ``update``
+    launches the fused program; lr/wd are evaluated eagerly each step and
+    fed as device arrays, so lr schedulers keep working instead of
+    constant-folding into the compiled step.
+
+    The stage-at-a-time eager path (forward, backward, then a per-parameter
+    updater loop outside jit — the reference's per-batch structure) remains
+    and is selected automatically when fusion cannot apply: NaiveEngine,
+    ``config.set("module.fused_step", "off")``, a non-jit-safe optimizer
+    (LBSGD, Nadam), ``inputs_need_grad``, grad_req "add", ctx-group
+    placement, an installed monitor, or a Module subclass that inspects
+    intermediate state (SVRGModule).  Explicit ``forward()``/``backward()``
+    calls are always eager, so gradient-inspection workflows keep
+    reference semantics; the fused path does not materialize
+    ``grad_dict``.  Numerical equivalence is tested both ways
+    (tests/test_module.py::test_module_fused_vs_eager_equivalence,
+    tests/test_parallel.py::test_module_vs_spmd_trainer_equivalence).
+    ``mx.parallel.SPMDTrainer`` remains the hot path for sharded multi-chip
+    training; fused Module.fit closes the single-chip gap
+    (docs/PERF_NOTES.md).
     """
 
     def __init__(self, symbol, data_names=("data",),
@@ -61,6 +77,16 @@ class Module(BaseModule):
         self._updater = None
         self._data_shapes = None
         self._label_shapes = None
+        # fused-train-step state: forward_backward defers the batch here and
+        # update() consumes it in one jitted dispatch (see class docstring)
+        self._pending_batch = None
+        # optimizer state for the fused path, keyed by param NAME so
+        # BucketingModule can share one dict across bucket modules
+        self._fused_shared = {"state": None, "t": 0, "hyper": {}}
+        # False until the first fused step after init_params/set_params:
+        # those share buffers with caller-owned NDArrays, which a donated
+        # program would invalidate — the first step copies, then owns
+        self._fused_owns_params = False
 
     # ------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -106,6 +132,8 @@ class Module(BaseModule):
         self.binded = True
         self.for_training = for_training
         self._inputs_need_grad = inputs_need_grad
+        self._pending_batch = None
+        self._fused_owns_params = False
 
     # -------------------------------------------------------------- params
     def init_params(self, initializer="default", arg_params=None,
@@ -143,31 +171,174 @@ class Module(BaseModule):
                 desc = InitDesc(name, attr_map.get(name, {}))
                 initializer(desc, arr)
         self.params_initialized = True
+        # buffers may now be shared with caller NDArrays (arr._data is
+        # src._data above) — the next fused step must copy before donating
+        self._fused_owns_params = False
 
     def get_params(self):
         assert self.binded and self.params_initialized
+        self._flush_pending()
         arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
         aux = {n: v.copy() for n, v in self._exec.aux_dict.items()}
         return arg, aux
 
     # ----------------------------------------------------------- optimizer
+    #: kvstore modes a single-process Module can honor.  Gradient reduction
+    #: is XLA's job inside the (sharded) step, so these all collapse to the
+    #: update_on_kvstore=False local-update path of the reference.
+    _LOCAL_KVSTORE_TYPES = ("local", "device", "nccl",
+                            "local_allreduce_cpu", "local_allreduce_device")
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        # the reference silently routed dist_* through a parameter server;
+        # here there is none — accepting it would train single-process while
+        # the script believes it is distributed, so it must be an error
+        kv_type = kvstore if isinstance(kvstore, str) or kvstore is None \
+            else getattr(kvstore, "type", None)
+        if kv_type is not None:
+            if kv_type.startswith("dist"):
+                raise ValueError(
+                    "kvstore=%r: Module has no parameter-server path; "
+                    "distributed training runs through "
+                    "mx.parallel.SPMDTrainer (jax.distributed + mesh "
+                    "sharding, see docs/MIGRATION.md)" % (kv_type,))
+            if kv_type not in self._LOCAL_KVSTORE_TYPES:
+                raise ValueError(
+                    "kvstore=%r is not a recognized mode; expected one of "
+                    "%s or None" % (kv_type, list(self._LOCAL_KVSTORE_TYPES)))
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
         self._optimizer = optimizer
         idx2name = {i: n for i, n in enumerate(self._param_names)}
         optimizer.param_idx2name = idx2name
         self._updater = opt_mod.get_updater(optimizer)
+        # a (re)initialized optimizer starts fresh fused state too
+        self._fused_shared = {"state": None, "t": 0, "hyper": {}}
         self.optimizer_initialized = True
 
+    # ------------------------------------------------------ fused train step
+    def _fused_active(self):
+        """Whether the NEXT forward_backward+update pair may run as one
+        fused jitted program (class docstring lists every condition)."""
+        if not (self.binded and self.optimizer_initialized
+                and self.for_training):
+            return False
+        if type(self) is not Module:
+            # subclasses (SVRGModule) inspect grad_dict between stages
+            return False
+        if self._inputs_need_grad or self._exec._placement \
+                or self._exec._monitor is not None:
+            return False
+        if not getattr(self._optimizer, "jit_safe", False):
+            return False
+        req = self._exec.grad_req
+        wrt = [n for n, r in req.items() if r != "null"]
+        if not wrt or any(req[n] != "write" for n in wrt):
+            return False
+        from .. import engine as _engine
+        from .. import config as _config
+        return _engine.fused_step_allowed() \
+            and _config.get("module.fused_step") != "off"
+
+    def _flush_pending(self):
+        """Replay a deferred batch through the EAGER forward+backward —
+        called when outputs/grads/aux are observed before update(), so
+        consumers see exactly the reference's stage-at-a-time state."""
+        batch = self._pending_batch
+        if batch is None:
+            return
+        self._pending_batch = None
+        BaseModule.forward_backward(self, batch)
+
+    def _run_fused(self, data_batch):
+        """One donated jit dispatch: forward + backward + optimizer update
+        (Executor.fused_step_fn).  Mirrors SPMDTrainer.step for the
+        symbolic path."""
+        from .. import random as _random
+        from ..parallel.trainer import (_opt_hyper_arrays, _state_to_jax)
+        from .. import profiler as _profiler
+        import jax
+        exec_ = self._exec
+        optimizer = self._optimizer
+        feeds = {}
+        for (name, _), arr in zip(self._data_shapes, data_batch.data):
+            feeds[name] = arr._data if isinstance(arr, NDArray) \
+                else jnp.asarray(arr)
+        if self._label_shapes and data_batch.label:
+            for (name, _), arr in zip(self._label_shapes, data_batch.label):
+                feeds[name] = arr._data if isinstance(arr, NDArray) \
+                    else jnp.asarray(arr)
+        exec_._feed_inputs(feeds)  # arg_dict state matches the eager path
+        req = exec_.grad_req
+        wrt = tuple(sorted(n for n in exec_.arg_dict
+                           if req.get(n, "null") != "null"))
+        feed_sig = tuple((n, tuple(v.shape), str(v.dtype))
+                         for n, v in sorted(feeds.items()))
+        fn = exec_.fused_step_fn(wrt, optimizer, feed_sig)
+        idxs = tuple(self._param_names.index(n) for n in wrt)
+        # lazily materialize per-name optimizer state (create_state wants
+        # the live weight for shape/dtype)
+        shared = self._fused_shared
+        if shared["state"] is None:
+            shared["state"] = {}
+        state = shared["state"]
+        for n, i in zip(wrt, idxs):
+            if n not in state:
+                state[n] = _state_to_jax(
+                    optimizer.create_state(i, exec_.arg_dict[n]))
+        # step count first — the lr scheduler reads num_update, and the
+        # eager Updater's per-index counts must agree after a fused run;
+        # continue from eager steps taken before fusion kicked in
+        shared["t"] = max(shared["t"], optimizer.num_update)
+        shared["t"] += 1
+        t = shared["t"]
+        optimizer.num_update = max(optimizer.num_update, t)
+        for i in idxs:
+            optimizer._index_update_count[i] = t
+        lrs, wds = _opt_hyper_arrays(optimizer, len(idxs), shared["hyper"],
+                                     indices=idxs)
+        donating = jax.default_backend() != "cpu"
+        if donating and not self._fused_owns_params:
+            # params may share buffers with caller NDArrays; copy once so
+            # donation can't invalidate what the caller still holds
+            wrt_vals = {n: jnp.array(exec_.arg_dict[n]._data) for n in wrt}
+        else:
+            wrt_vals = {n: exec_.arg_dict[n]._data for n in wrt}
+        opt_state = {n: state[n] for n in wrt}
+        rest_env = {n: v for n, v in exec_._env().items()
+                    if n not in opt_state and n not in feeds}
+        key = _random.new_eager_seed_key()
+        new_w, new_s, aux_updates, outs = fn(
+            wrt_vals, opt_state, rest_env, feeds, key,
+            jnp.asarray(t, jnp.int32), lrs, wds)
+        for n in wrt:
+            exec_.arg_dict[n]._data = new_w[n]
+            state[n] = new_s[n]
+        for n, v in aux_updates.items():
+            if n in exec_.aux_dict:
+                exec_.aux_dict[n]._data = v
+        exec_.outputs = [_wrap(o) for o in outs]
+        self._fused_owns_params = True
+        _profiler.counter_increment("fused_steps")
+
     # ------------------------------------------------------------- running
+    def forward_backward(self, data_batch):
+        if self._fused_active():
+            # two deferrals without an update(): the first batch's
+            # outputs/aux side effects must land in order — replay it
+            self._flush_pending()
+            self._pending_batch = data_batch
+            return
+        super().forward_backward(data_batch)
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._flush_pending()
         if is_train is None:
             is_train = self.for_training
         feeds = {}
@@ -180,13 +351,22 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        self._flush_pending()
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
         """Apply optimizer to parameters (reference module.py:646; the
         kvstore push/pull collapses — gradient reduction is XLA's job on a
-        sharded step, a no-op on one chip)."""
+        sharded step, a no-op on one chip).  A batch deferred by
+        forward_backward is consumed here as ONE fused jit dispatch."""
         assert self.optimizer_initialized
+        batch = self._pending_batch
+        if batch is not None:
+            self._pending_batch = None
+            self._run_fused(batch)
+            return
+        from .. import profiler as _profiler
+        _profiler.counter_increment("eager_steps")
         for i, name in enumerate(self._param_names):
             g = self._exec.grad_dict.get(name)
             if g is None:
@@ -194,13 +374,16 @@ class Module(BaseModule):
             self._updater(i, g, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
+        self._flush_pending()
         return list(self._exec.outputs)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self._inputs_need_grad
+        self._flush_pending()
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._flush_pending()
         eval_metric.update_dict(
             {n: l for (n, _), l in zip(self._label_shapes, labels)}
             if self._label_shapes else {},

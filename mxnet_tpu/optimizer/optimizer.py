@@ -46,6 +46,14 @@ class Optimizer:
 
     opt_registry = {}
 
+    # ``step`` is a pure function of (weight, grad, state, lr, wd, t) and
+    # may be traced into a fused jit train step with lr/wd/t fed as device
+    # arrays (Module's fused path, SPMDTrainer).  Subclasses whose step
+    # reads or mutates Python-side per-step state that is NOT in ``state``
+    # (so it would constant-fold at trace time or drift across traced
+    # calls) must set this False to keep the eager per-parameter path.
+    jit_safe = True
+
     @staticmethod
     def register(klass):
         name = klass.__name__.lower()
@@ -403,6 +411,11 @@ class LBSGD(Optimizer):
     The adaptive-rate core (LARS-style) is kept; warmup strategies linear /
     power2 / sqrt are applied on the lr."""
 
+    # step() reads self.num_update eagerly for the warmup multiplier — in a
+    # fused jit step the multiplier would constant-fold at trace time and
+    # freeze the warmup schedule.
+    jit_safe = False
+
     def __init__(self, momentum=0.0, multi_precision=False,
                  warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
                  updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
@@ -700,6 +713,11 @@ class Adamax(Optimizer):
 @register
 class Nadam(Optimizer):
     """Nesterov Adam (reference: optimizer.py:1787)."""
+
+    # step() mutates self.m_schedule (host-side running product) — traced
+    # into a compiled program the mutation would happen once at trace time
+    # instead of every step.
+    jit_safe = False
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):

@@ -25,7 +25,7 @@ from .mesh import data_parallel_mesh
 __all__ = ["SPMDTrainer", "build_train_step"]
 
 
-def _opt_hyper_arrays(optimizer, num_params, cache=None):
+def _opt_hyper_arrays(optimizer, num_params, cache=None, indices=None):
     """Evaluate per-parameter lr/wd EAGERLY for the current num_update.
 
     These are fed into the jitted step as traced arguments so an
@@ -37,11 +37,19 @@ def _opt_hyper_arrays(optimizer, num_params, cache=None):
     schedule produced the same values as last step — on a tunneled device
     every upload is a round trip, and constant-lr training would otherwise
     pay two per step for identical bytes.
+
+    ``indices`` overrides the parameter indices the per-param multipliers
+    are looked up under (Module's fused step trains a subset of
+    ``_param_names``, whose updater indices are not contiguous).
     """
-    lr_host = tuple(optimizer._get_lr(i) for i in range(num_params))
-    wd_host = tuple(optimizer._get_wd(i) for i in range(num_params))
+    idxs = tuple(indices) if indices is not None \
+        else tuple(range(num_params))
+    lr_host = tuple(optimizer._get_lr(i) for i in idxs)
+    wd_host = tuple(optimizer._get_wd(i) for i in idxs)
     if cache is not None and cache.get("host") == (lr_host, wd_host):
         return cache["dev"]
+    from .. import profiler as _profiler
+    _profiler.counter_increment("host_syncs", 2)  # lr + wd uploads
     dev = (jnp.asarray(lr_host, jnp.float32),
            jnp.asarray(wd_host, jnp.float32))
     if cache is not None:
@@ -300,6 +308,8 @@ class SPMDTrainer:
             self._materialize(data)
         if self._jitted is None:
             self._jitted = self._build()
+            from .. import profiler as _profiler
+            _profiler.counter_increment("fused_compiles")
         data = jax.device_put(jnp.asarray(data), self._batch_sharding)
         label = jax.device_put(jnp.asarray(label), self._batch_sharding)
         self._step_num += 1
@@ -324,6 +334,8 @@ class SPMDTrainer:
         new_train, new_aux, self.opt_state, loss = self._jitted(
             train, aux, self.opt_state, data, label, key,
             jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
+        from .. import profiler as _profiler
+        _profiler.counter_increment("fused_steps")
         self.params = {}
         self.params.update(new_train)
         self.params.update(new_aux)

@@ -20,13 +20,50 @@ import threading
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "set_state", "Domain", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "profiler_scope"]
+           "Marker", "scope", "profiler_scope", "counters", "reset_counters",
+           "counter_increment"]
 
 _CONFIG = {"profile_all": False, "filename": "profile.json",
            "aggregate_stats": True}
 _STATE = {"running": False, "trace_dir": None, "t0": None}
 _EVENTS = []
 _EVENTS_LOCK = threading.Lock()
+
+# ------------------------------------------------------- dispatch counters
+# Compile/dispatch observability for the fused train-step paths (Module's
+# fused step and SPMDTrainer): recompile churn shows up as a rising
+# `fused_compiles` count instead of having to be inferred from step-time
+# jitter.  `host_syncs` counts the per-step host->device hyperparameter
+# uploads (lr/wd schedule values that changed since the last step) — the
+# only host traffic a healthy fused step pays.
+_COUNTERS_LOCK = threading.Lock()
+_COUNTER_NAMES = ("fused_steps", "fused_compiles", "eager_steps",
+                  "host_syncs")
+_COUNTERS = dict.fromkeys(_COUNTER_NAMES, 0)
+
+
+def counter_increment(name, delta=1):
+    """Bump a dispatch counter (unknown names are created on first use so
+    callers can add ad-hoc counters without registering)."""
+    with _COUNTERS_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+
+
+def counters():
+    """Snapshot of the dispatch counters: steps run per path, programs
+    compiled, and host syncs.  `fused_steps`/`eager_steps` count Module /
+    SPMDTrainer train iterations by path, `fused_compiles` counts distinct
+    compiled step programs (one per shape signature — a rising count at a
+    fixed shape is recompile churn), `host_syncs` counts hyperparameter
+    host->device uploads."""
+    with _COUNTERS_LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters():
+    with _COUNTERS_LOCK:
+        for k in list(_COUNTERS):
+            _COUNTERS[k] = 0
 
 
 def set_config(**kwargs):
@@ -190,6 +227,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         host.setdefault(e["name"], []).append(e["dur"])
     lines += _format_table(_stats_rows(host) if host else {},
                            "Host events", sort_by, ascending)
+    snap = counters()
+    if any(snap.values()):
+        lines.append("")
+        lines.append("Dispatch counters (fused train steps)")
+        for k in sorted(snap):
+            lines.append("%-40s %8d" % (k, snap[k]))
     return "\n".join(lines)
 
 

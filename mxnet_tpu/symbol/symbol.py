@@ -886,6 +886,7 @@ class Executor:
         self.outputs = []
         self._fwd_cache = {}
         self._bwd_cache = {}
+        self._fused_cache = {}
         self._monitor = None
         # ctx-group model parallelism: {name: jax.Device} where the user
         # pinned each param via group2ctx — the single source of truth the
@@ -1027,6 +1028,85 @@ class Executor:
             self._bwd_cache[key_sig] = jax.jit(run,
                                                static_argnames=())
         return self._bwd_cache[key_sig]
+
+    def fused_step_fn(self, wrt, optimizer, feed_sig):
+        """ONE jitted program carrying forward + backward + optimizer
+        update — the CachedOp ``static_alloc=True`` analog for the symbolic
+        path (reference: src/imperative/cached_op.cc StaticForward/
+        StaticBackward collapse per-op dispatch; here the whole train
+        iteration is a single XLA executable and XLA owns the memory plan).
+
+        ``wrt`` is the ordered tuple of trainable arg names; ``feed_sig``
+        the per-batch input shape/dtype signature.  One program per
+        (wrt, feed_sig, config-epoch) — parameters, optimizer state and the
+        batch are traced pytree arguments, and params/state are DONATED on
+        accelerator backends so the update happens in-place in HBM.
+
+        Signature of the returned callable::
+
+            new_params, new_state, aux_updates, outputs = fn(
+                wrt_vals, opt_state, rest_env, feeds, key, t, lrs, wds)
+
+        lr/wd arrive as device arrays evaluated eagerly per step (the
+        ``_opt_hyper_arrays`` pattern from mxnet_tpu/parallel/trainer.py),
+        so lr schedulers keep working instead of constant-folding; ``t`` is
+        the traced update count for bias-corrected optimizers (Adam &c).
+        """
+        from .. import config as _config
+        sym = self._symbol
+        wrt_t = tuple(wrt)
+        rescale = float(optimizer.rescale_grad)
+        clip = optimizer.clip_gradient
+        # the program closes over the optimizer, so its identity (and the
+        # scalars baked in at trace time) is part of the key; cached entries
+        # keep their optimizer alive, so id() stays unambiguous
+        key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig,
+                   _config.epoch())
+        fn = self._fused_cache.get(key_sig)
+        if fn is not None:
+            return fn
+        # evict programs compiled under superseded knob epochs (same
+        # invalidation contract as _fwd_cache/_bwd_cache)
+        self._fused_cache = {k: v for k, v in self._fused_cache.items()
+                             if k[-1] == key_sig[-1]}
+
+        def run(wrt_vals, opt_state, rest_env, feeds, key, t, lrs, wds):
+            env = dict(rest_env)
+            env.update(feeds)
+
+            def fwd(wv):
+                e = dict(env)
+                e.update(wv)
+                aux_updates = {}
+                with _random.trace_key_scope(key):
+                    outs = _eval_symbol(sym, e, True, aux_updates)
+                return outs, aux_updates
+
+            outs, vjp, aux_updates = jax.vjp(fwd, wrt_vals, has_aux=True)
+            # out_grads=None semantics: ones cotangents, as in backward()
+            (grads,) = vjp([jnp.ones_like(o) for o in outs])
+            new_w = {}
+            new_s = {}
+            # stochastic optimizers (SGLD) draw from the step's traced key
+            with _random.trace_key_scope(jax.random.fold_in(key, 1)):
+                for i, n in enumerate(wrt_t):
+                    g = grads[n] * rescale
+                    if clip is not None:
+                        g = jnp.clip(g, -clip, clip)
+                    w, s = optimizer.step(wrt_vals[n], g, opt_state[n],
+                                          lrs[i], wds[i], t)
+                    new_w[n] = w.astype(wrt_vals[n].dtype)
+                    new_s[n] = s
+            return new_w, new_s, aux_updates, outs
+
+        # donation needs a real accelerator: the CPU backend can't alias
+        # donated buffers (it would only warn and copy anyway)
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._fused_cache[key_sig] = fn
+        from .. import profiler as _profiler
+        _profiler.counter_increment("fused_compiles")
+        return fn
 
     def backward(self, out_grads=None):
         from ..ndarray.ndarray import NDArray, _wrap

@@ -1,0 +1,21 @@
+"""Subprocess wiring for tools/check_fused_step.py — the fast fused-step
+smoke must keep passing from a clean interpreter (no test-session state),
+exactly how CI and operators invoke it."""
+import json
+import os
+import subprocess
+import sys
+
+
+def test_check_fused_step_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "check_fused_step.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=root)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    # last stdout line is the JSON report
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"], report
+    assert report["counters"]["fused_compiles"] == 1, report
+    assert report["max_param_diff"] < 1e-3, report

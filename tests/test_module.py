@@ -126,6 +126,168 @@ def test_bucketing_module_shares_params():
     assert len(mod._buckets) == 2
 
 
+def _fixed_init_params(seed=7):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(32, 10).astype(np.float32)
+                                      * 0.1),
+            "fc1_bias": mx.nd.array(np.zeros(32, np.float32)),
+            "fc2_weight": mx.nd.array(rng.randn(3, 32).astype(np.float32)
+                                      * 0.1),
+            "fc2_bias": mx.nd.array(np.zeros(3, np.float32))}
+
+
+def _train_mlp(mode, optimizer="adam", steps=6, lr=0.05):
+    """Train the toy MLP under module.fused_step=`mode`; returns params."""
+    from mxnet_tpu import config
+    X, Y = _toy_data(n=96)
+    prev = config.get("module.fused_step")
+    config.set("module.fused_step", mode)
+    try:
+        mod = mx.mod.Module(_mlp_softmax())
+        mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+        mod.init_params(initializer=None, arg_params=_fixed_init_params())
+        mod.init_optimizer(optimizer=optimizer,
+                           optimizer_params={"learning_rate": lr})
+        it = mx.io.NDArrayIter(X, Y, batch_size=16)
+        done = 0
+        while done < steps:
+            for batch in it:
+                if done == steps:
+                    break
+                mod.train_step(batch)
+                done += 1
+            it.reset()
+        return mod.get_params()[0]
+    finally:
+        config.set("module.fused_step", prev)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_module_fused_vs_eager_equivalence(optimizer):
+    """The fused single-dispatch train step and the reference's
+    stage-at-a-time eager path land on the same weights."""
+    fused = _train_mlp("auto", optimizer)
+    eager = _train_mlp("off", optimizer)
+    for name in fused:
+        np.testing.assert_allclose(fused[name].asnumpy(),
+                                   eager[name].asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_fused_recompile_guard():
+    """N fixed-shape steps compile exactly ONE fused program, and every
+    step dispatches through it (no silent eager fallback)."""
+    from mxnet_tpu import profiler
+    profiler.reset_counters()
+    _train_mlp("auto", steps=6)
+    c = profiler.counters()
+    assert c["fused_compiles"] == 1, c
+    assert c["fused_steps"] == 6, c
+    assert c["eager_steps"] == 0, c
+
+
+def test_fused_knob_off_stays_eager():
+    from mxnet_tpu import profiler
+    profiler.reset_counters()
+    _train_mlp("off", steps=3)
+    c = profiler.counters()
+    assert c["fused_steps"] == 0 and c["fused_compiles"] == 0, c
+    assert c["eager_steps"] == 3, c
+
+
+def test_fused_naive_engine_falls_back_eager():
+    from mxnet_tpu import engine, profiler
+    engine.set_engine_type("NaiveEngine")
+    try:
+        profiler.reset_counters()
+        _train_mlp("auto", steps=2)
+        c = profiler.counters()
+        assert c["fused_steps"] == 0, c
+        assert c["eager_steps"] == 2, c
+    finally:
+        engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_fused_outputs_observable_before_update():
+    """get_outputs()/update_metric() between forward_backward and update
+    must see the reference's stage-at-a-time state (the deferred batch
+    replays eagerly), and training still proceeds."""
+    X, Y = _toy_data(n=16)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    mod.init_params(initializer=None, arg_params=_fixed_init_params())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(mx.io.NDArrayIter(X, Y, batch_size=16))
+    mod.forward_backward(batch)
+    outs = mod.get_outputs()
+    assert outs and outs[0].shape == (16, 3)
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.update()
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(w_before, w_after)
+
+
+def test_init_optimizer_validates_kvstore():
+    """dist_* kvstore modes have no parameter-server path here and must
+    raise instead of silently training single-process; local modes and
+    None are accepted (satellite: the reference ignored the argument)."""
+    def fresh():
+        mod = mx.mod.Module(_mlp_softmax())
+        mod.bind([("data", (8, 10))], [("softmax_label", (8,))])
+        mod.init_params(mx.init.Xavier())
+        return mod
+
+    for bad in ("dist_sync", "dist_async", "dist_device_sync"):
+        with pytest.raises(ValueError, match="SPMDTrainer"):
+            fresh().init_optimizer(kvstore=bad)
+    with pytest.raises(ValueError, match="not a recognized"):
+        fresh().init_optimizer(kvstore="bogus")
+    for ok in (None, "local", "device", mx.kv.create("local")):
+        fresh().init_optimizer(kvstore=ok)
+
+
+def test_bucketing_fused_step_cache_reuse():
+    """Bucket switches reuse cached fused programs: 4 steps over buckets
+    (8, 4, 8, 4) compile exactly one program per bucket shape."""
+    from mxnet_tpu import profiler
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="shared_fc",
+                                  flatten=False)
+        h = mx.sym.mean(h, axis=1)
+        h = mx.sym.FullyConnected(h, num_hidden=3, name="out_fc")
+        return (mx.sym.SoftmaxOutput(h, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind([("data", (4, 8, 5))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    profiler.reset_counters()
+    rng = np.random.RandomState(0)
+    w0 = mod.get_params()[0]["shared_fc_weight"].asnumpy().copy()
+    for seq_len in (8, 4, 8, 4):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(rng.uniform(size=(4, seq_len, 5))
+                         .astype(np.float32))],
+            [mx.nd.array(rng.randint(0, 3, (4,)).astype(np.float32))],
+            provide_data=[mx.io.DataDesc("data", (4, seq_len, 5))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        batch.bucket_key = seq_len
+        mod.forward_backward(batch)
+        mod.update()
+    c = profiler.counters()
+    assert c["fused_steps"] == 4, c
+    assert c["fused_compiles"] == 2, c
+    w1 = mod.get_params()[0]["shared_fc_weight"].asnumpy()
+    assert not np.allclose(w0, w1)
+    assert len(mod._buckets) == 2
+
+
 def test_csviter(tmp_path):
     data = np.arange(24, dtype=np.float32).reshape(8, 3)
     label = np.arange(8, dtype=np.float32)

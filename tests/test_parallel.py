@@ -242,6 +242,56 @@ def test_module_vs_spmd_trainer_equivalence():
     np.testing.assert_allclose(spmd_w, mod_w, rtol=1e-4, atol=1e-5)
 
 
+def test_fused_module_vs_spmd_trainer_equivalence():
+    """Module's FUSED train step (one jitted fwd+bwd+update dispatch, the
+    default fit path) matches SPMDTrainer's fused step on the same dense
+    model — closing the triangle with
+    test_module_vs_spmd_trainer_equivalence, which pins the eager path."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    Y = rng.randint(0, 3, (32,)).astype(np.float32)
+    W0 = (rng.randn(3, 6) * 0.1).astype(np.float32)
+    b0 = np.zeros(3, np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+    mod = mx.mod.Module(out)
+    mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.set_params({"fc_weight": mx.nd.array(W0),
+                    "fc_bias": mx.nd.array(b0)}, {})
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    profiler.reset_counters()
+    for e in range(3):
+        it = mx.io.NDArrayIter(X, Y, batch_size=8)
+        for batch in it:
+            mod.train_step(batch)
+    assert profiler.counters()["fused_steps"] == 12
+    mod_w = mod.get_params()[0]["fc_weight"].asnumpy()
+
+    net = gluon.nn.Dense(3, in_units=6)
+    net.initialize()
+    net.weight.set_data(mx.nd.array(W0))
+    net.bias.set_data(mx.nd.array(b0))
+    tr = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, mesh=make_mesh({"dp": -1}))
+    for e in range(3):
+        for s in range(0, 32, 8):
+            tr.step(X[s:s + 8], Y[s:s + 8])
+    tr.sync()
+    spmd_w = net.weight.data().asnumpy()
+
+    np.testing.assert_allclose(spmd_w, mod_w, rtol=1e-4, atol=1e-5)
+
+
 def test_spmd_trainer_sharded_checkpoint_resume_bitwise(tmp_path):
     """Orbax sharded checkpoint (every host writes only its shards, no
     gather — SURVEY §5.4's TPU-native layout): train -> save_sharded ->
