@@ -46,6 +46,7 @@ _LAZY = {
     "callback": ".callback",
     "model": ".model",
     "profiler": ".profiler",
+    "telemetry": ".telemetry",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "parallel": ".parallel",

@@ -198,6 +198,24 @@ register_knob(
     "profiler.filename", "MXNET_PROFILER_FILENAME", str, "profile.json",
     "default Chrome-trace output path for mx.profiler.dump().")
 
+# telemetry step log (docs/OBSERVABILITY.md)
+register_knob(
+    "telemetry.sink", "MXNET_TPU_TELEMETRY", str, "",
+    "structured step-event log sink: 'jsonl:<path>' appends one JSON "
+    "record per train step (Module/SPMDTrainer/gluon.Trainer) with wall "
+    "time, dispatch path, compile/host-sync deltas, throughput, and the "
+    "device memory watermark; summarize with tools/telemetry_report.py. "
+    "Empty (default) disables the log; the metrics registry itself stays "
+    "on at near-zero cost.")
+
+
+def _apply_telemetry_sink(value):
+    from . import telemetry
+    telemetry.configure_sink(value)
+
+
+_ON_SET["telemetry.sink"] = _apply_telemetry_sink
+
 # kvstore / gradient sync
 register_knob(
     "kvstore.grad_compression_threshold",

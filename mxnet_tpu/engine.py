@@ -76,4 +76,6 @@ def maybe_sync(arrays):
     debugging property MXNET_ENGINE_TYPE=NaiveEngine provided."""
     if naive_engine_enabled():
         import jax
+        from . import telemetry as _telemetry
+        _telemetry.counter("engine.naive_syncs").inc()
         jax.block_until_ready(arrays)

@@ -140,15 +140,20 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Makes one step of parameter update
-        (reference: trainer.py:305)."""
-        rescale_grad = self._scale / batch_size
-        self._check_and_rescale_grad(rescale_grad)
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._params_to_init:
-            self._init_params()
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        (reference: trainer.py:305).  Feeds the ``gluon.step`` telemetry
+        timer; with the JSONL step log on, emits one step record (path
+        "eager" — the per-parameter updater loop) per call."""
+        from .. import telemetry as _telemetry
+        with _telemetry.step_scope("gluon", samples=int(batch_size),
+                                   default_path="eager"):
+            rescale_grad = self._scale / batch_size
+            self._check_and_rescale_grad(rescale_grad)
+            if not self._kv_initialized:
+                self._init_kvstore()
+            if self._params_to_init:
+                self._init_params()
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kv_initialized and self._kvstore:

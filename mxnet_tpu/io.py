@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from collections import namedtuple
 
 import numpy as _np
 
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray, _wrap
 import jax.numpy as jnp
 
@@ -74,7 +76,13 @@ class DataIter:
         raise NotImplementedError
 
     def __next__(self):
-        return self.next()
+        # batch-fetch latency for every iterator on the pipeline boundary:
+        # a slow p99 here means the chip starves waiting on host data
+        t0 = _time.perf_counter()
+        batch = self.next()
+        _telemetry.timer("io.batch_fetch").observe(
+            _time.perf_counter() - t0)
+        return batch
 
     # legacy pull-style API
     def iter_next(self):
@@ -371,6 +379,9 @@ class PrefetchingIter(DataIter):
     def next(self):
         if getattr(self, "_exhausted", False):
             raise StopIteration
+        # depth sampled at consume time: a gauge pinned at 0 means the
+        # prefetch thread can't keep ahead of the training loop
+        _telemetry.gauge("io.prefetch_queue_depth").set(self._queue.qsize())
         item = self._queue.get()
         if item is None:
             self._exhausted = True

@@ -23,12 +23,30 @@ import jax.numpy as jnp
 
 from .ndarray.ndarray import NDArray, _wrap
 from . import optimizer as opt
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create"]
 
 
 def _key_str(key):
     return str(key)
+
+
+def _payload_bytes(value):
+    """Wire-size accounting for push/pull telemetry: bytes of every array
+    in a possibly-nested value list (per-device copies each count — they
+    each cross the reduce boundary in the reference model)."""
+    if isinstance(value, (list, tuple)):
+        return sum(_payload_bytes(v) for v in value)
+    data = getattr(value, "_data", value)
+    try:
+        return int(data.size) * int(data.dtype.itemsize)
+    except Exception:  # noqa: BLE001 — sparse wrappers without one buffer
+        try:
+            import numpy as _np
+            return int(_np.prod(value.shape)) * 4
+        except Exception:  # noqa: BLE001
+            return 0
 
 
 class KVStore:
@@ -131,6 +149,8 @@ class KVStore:
         (reference: kvstore.py:178; KVStoreLocal::PushImpl kvstore_local.h:206).
         """
         keys, values = _normalize_push(key, value)
+        _telemetry.counter("kvstore.push_calls").inc()
+        _telemetry.counter("kvstore.push_bytes").inc(_payload_bytes(values))
         for k, v in zip(keys, values):
             merged = self._merge(v)
             payload, compressed = self._compress(k, merged)
@@ -151,6 +171,8 @@ class KVStore:
         (reference: kvstore.py:248)."""
         assert out is not None
         keys, outs = _normalize_push(key, out)
+        _telemetry.counter("kvstore.pull_calls").inc()
+        _telemetry.counter("kvstore.pull_bytes").inc(_payload_bytes(outs))
         for k, o in zip(keys, outs):
             src = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]

@@ -67,9 +67,14 @@ class BaseModule:
         """One optimization step on `data_batch` — forward_backward + update.
         Module runs this as ONE fused jitted program when eligible (see
         Module's PERFORMANCE NOTE); elsewhere it is the literal two-stage
-        reference sequence."""
-        self.forward_backward(data_batch)
-        self.update()
+        reference sequence.  Each step feeds the ``module.step`` telemetry
+        timer, and one JSONL step record (path fused/eager, compile and
+        host-sync deltas, throughput) when the step log is enabled
+        (docs/OBSERVABILITY.md)."""
+        from .. import telemetry as _telemetry
+        with _telemetry.step_scope("module", batch=data_batch):
+            self.forward_backward(data_batch)
+            self.update()
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):

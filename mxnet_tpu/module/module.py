@@ -253,6 +253,10 @@ class Module(BaseModule):
         if batch is None:
             return
         self._pending_batch = None
+        # an observed deferral costs a full eager fwd+bwd replay — a rising
+        # count means something inspects state between fused steps
+        from .. import telemetry as _telemetry
+        _telemetry.counter("module.eager_replays").inc()
         BaseModule.forward_backward(self, batch)
 
     def _run_fused(self, data_batch):

@@ -56,8 +56,17 @@ class Monitor:
         res = []
         if self.sort:
             self.queue.sort(key=lambda x: x[1])
+        from . import telemetry as _telemetry
+        emit = _telemetry.enabled()
         for n, k, v_list in self.queue:
             res.append((n, k, str(v_list)))
+            if emit:
+                try:
+                    stat = float(v_list)
+                except (TypeError, ValueError):
+                    stat = str(v_list)
+                _telemetry.log_event("monitor", step=int(n), name=k,
+                                     stat=stat)
         self.queue = []
         return res
 

@@ -298,12 +298,29 @@ class SPMDTrainer:
 
     # ------------------------------------------------------------ public
     def step(self, data, label, lr_scale=1.0):
-        """Run one fused train step; returns the (device-resident) loss."""
+        """Run one fused train step; returns the (device-resident) loss.
+
+        Feeds the ``spmd.step`` telemetry timer every call; with the JSONL
+        step log enabled each step also emits one record carrying the
+        collective mesh shape, compile/host-sync deltas, and throughput
+        (docs/OBSERVABILITY.md).  Wall time is host-side dispatch time —
+        async device work overlaps the next step by design."""
         from ..ndarray.ndarray import NDArray
+        from .. import telemetry as _telemetry
         if isinstance(data, NDArray):
             data = data._data
         if isinstance(label, NDArray):
             label = label._data
+        with _telemetry.step_scope(
+                "spmd", samples=int(data.shape[0]) if
+                getattr(data, "ndim", 0) else None,
+                shape=tuple(getattr(data, "shape", ())) or None,
+                mesh={n: int(s) for n, s in zip(self.mesh.axis_names,
+                                                self.mesh.devices.shape)},
+                default_path="fused"):
+            return self._step_impl(data, label, lr_scale)
+
+    def _step_impl(self, data, label, lr_scale):
         if self.params is None:
             self._materialize(data)
         if self._jitted is None:

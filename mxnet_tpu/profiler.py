@@ -25,7 +25,8 @@ __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
 
 _CONFIG = {"profile_all": False, "filename": "profile.json",
            "aggregate_stats": True}
-_STATE = {"running": False, "trace_dir": None, "t0": None}
+_STATE = {"running": False, "trace_dir": None, "last_trace_dir": None,
+          "t0": None}
 _EVENTS = []
 _EVENTS_LOCK = threading.Lock()
 
@@ -36,34 +37,37 @@ _EVENTS_LOCK = threading.Lock()
 # jitter.  `host_syncs` counts the per-step host->device hyperparameter
 # uploads (lr/wd schedule values that changed since the last step) — the
 # only host traffic a healthy fused step pays.
-_COUNTERS_LOCK = threading.Lock()
+#
+# Since the telemetry PR these live on the mx.telemetry registry (one
+# thread-safe home for every runtime metric); this facade keeps the PR-1
+# API working and `counters()` now returns the FULL counter registry
+# (dispatch + kvstore/io/engine counters) — the four dispatch names are
+# always present.
 _COUNTER_NAMES = ("fused_steps", "fused_compiles", "eager_steps",
                   "host_syncs")
-_COUNTERS = dict.fromkeys(_COUNTER_NAMES, 0)
 
 
 def counter_increment(name, delta=1):
-    """Bump a dispatch counter (unknown names are created on first use so
+    """Bump a registry counter (unknown names are created on first use so
     callers can add ad-hoc counters without registering)."""
-    with _COUNTERS_LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+    from . import telemetry
+    telemetry.counter(name).inc(delta)
 
 
 def counters():
-    """Snapshot of the dispatch counters: steps run per path, programs
-    compiled, and host syncs.  `fused_steps`/`eager_steps` count Module /
-    SPMDTrainer train iterations by path, `fused_compiles` counts distinct
-    compiled step programs (one per shape signature — a rising count at a
-    fixed shape is recompile churn), `host_syncs` counts hyperparameter
-    host->device uploads."""
-    with _COUNTERS_LOCK:
-        return dict(_COUNTERS)
+    """Snapshot of the counter registry: steps run per path, programs
+    compiled, host syncs, plus any subsystem counters (kvstore.*, io.*).
+    `fused_steps`/`eager_steps` count Module / SPMDTrainer train iterations
+    by path, `fused_compiles` counts distinct compiled step programs (one
+    per shape signature — a rising count at a fixed shape is recompile
+    churn), `host_syncs` counts hyperparameter host->device uploads."""
+    from . import telemetry
+    return telemetry.snapshot()["counters"]
 
 
 def reset_counters():
-    with _COUNTERS_LOCK:
-        for k in list(_COUNTERS):
-            _COUNTERS[k] = 0
+    from . import telemetry
+    telemetry.reset_counters()
 
 
 def set_config(**kwargs):
@@ -84,6 +88,9 @@ def start(profile_process="worker"):
     import jax
     if _STATE["running"]:
         return
+    # a new run invalidates the previous run's trace for implicit reads —
+    # device_op_events() must never silently serve stale data mid-run
+    _STATE["last_trace_dir"] = None
     trace_dir = _CONFIG.get("trace_dir") or os.path.splitext(
         _CONFIG["filename"])[0] + "_xplane"
     try:
@@ -104,6 +111,12 @@ def stop(profile_process="worker"):
             jax.profiler.stop_trace()
         except Exception:
             pass
+    # the just-finished capture stays readable (dumps() right after stop()
+    # is the normal UX) via last_trace_dir, but the ACTIVE dir is cleared:
+    # a later device_op_events() during the next run can no longer silently
+    # read this run's trace.  Explicit reads use the trace_dir= argument.
+    _STATE["last_trace_dir"] = _STATE["trace_dir"]
+    _STATE["trace_dir"] = None
     _STATE["running"] = False
 
 
@@ -137,11 +150,17 @@ def device_op_events(trace_dir=None):
     reference's aggregate_stats.cc collects from kernel timestamps.  Host
     python threads are excluded.  Empty dict when no device plane exists
     (e.g. CPU backend, which exports only host tracing).
+
+    With no ``trace_dir`` argument the ACTIVE capture is read, falling back
+    to the run that ``stop()`` just finished; a previous run's directory is
+    never read implicitly once a new ``start()`` happens (pass ``trace_dir=``
+    explicitly to inspect an old capture).
     """
     import glob
     import gzip
 
-    trace_dir = trace_dir or _STATE.get("trace_dir")
+    trace_dir = trace_dir or _STATE.get("trace_dir") \
+        or _STATE.get("last_trace_dir")
     if not trace_dir:
         return {}
     path = _latest_trace_file(trace_dir)
@@ -202,19 +221,46 @@ def _format_table(agg, title, sort_by, ascending):
     return lines
 
 
+def _format_timer_table(timers, sort_by, ascending):
+    order = sort_by if sort_by in ("count", "total", "min", "max") \
+        else "total"
+    rows = sorted(timers.items(), key=lambda kv: kv[1][order],
+                  reverse=not ascending)
+    lines = ["Telemetry timers",
+             "%-32s %8s %11s %10s %10s %10s %10s"
+             % ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                "P50(ms)", "P99(ms)")]
+    for name, s in rows:
+        lines.append("%-32s %8d %11.3f %10.3f %10.3f %10.3f %10.3f"
+                     % (name[:32], s["count"], s["total"] * 1e3,
+                        s["min"] * 1e3, s["max"] * 1e3, s["p50"] * 1e3,
+                        s["p99"] * 1e3))
+    return lines
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Aggregate stats table (reference: aggregate_stats.cc).
 
-    Two sections: DEVICE ops parsed from the captured jax.profiler trace
+    Sections: DEVICE ops parsed from the captured jax.profiler trace
     (per-XLA-op kernel times on the TPU — the question "which op is slow on
-    device") followed by host-side facade events (Task/Frame/scope).  The
+    device"), host-side facade events (Task/Frame/scope), then the
+    mx.telemetry registry — step/phase timers with percentiles, gauges
+    (queue depths), and counters (dispatch paths, kvstore traffic).  The
     device section is present whenever a trace with a device plane was
     captured between start() and stop().
+
+    ``reset=True`` clears BOTH the host event buffer and the telemetry
+    registry (counters included — PR-1 left the dispatch counters running
+    across resets, which made back-to-back profiled runs additive).
     """
+    from . import telemetry
     with _EVENTS_LOCK:
         events = list(_EVENTS)
         if reset:
             _EVENTS.clear()
+    snap = telemetry.snapshot()
+    if reset:
+        telemetry.reset()
     lines = []
     dev = device_op_events()
     if dev:
@@ -227,12 +273,17 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         host.setdefault(e["name"], []).append(e["dur"])
     lines += _format_table(_stats_rows(host) if host else {},
                            "Host events", sort_by, ascending)
-    snap = counters()
-    if any(snap.values()):
+    lines.append("")
+    lines += _format_timer_table(snap["timers"], sort_by, ascending)
+    lines.append("")
+    lines.append("Gauges")
+    for k in sorted(snap["gauges"]):
+        lines.append("%-40s %12s" % (k, snap["gauges"][k]))
+    if any(snap["counters"].values()):
         lines.append("")
-        lines.append("Dispatch counters (fused train steps)")
-        for k in sorted(snap):
-            lines.append("%-40s %8d" % (k, snap[k]))
+        lines.append("Counters (dispatch + subsystem)")
+        for k in sorted(snap["counters"]):
+            lines.append("%-40s %12d" % (k, snap["counters"][k]))
     return "\n".join(lines)
 
 
@@ -301,17 +352,27 @@ class Counter:
     def __init__(self, domain, name, value=None):
         self.name = "%s::%s" % (domain.name, name) if domain else name
         self.value = value or 0
+        self._lock = threading.Lock()
 
     def set_value(self, value):
-        self.value = value
+        with self._lock:
+            self.value = value
+        self._record_value(value)
+
+    def _record_value(self, value):
         t = time.perf_counter()
         _record("counter", self.name, t, t, {"value": value})
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        # read-modify-write under the lock: concurrent increments from
+        # engine/io threads must never lose updates
+        with self._lock:
+            self.value += delta
+            value = self.value
+        self._record_value(value)
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        self.increment(-delta)
 
 
 class Marker:

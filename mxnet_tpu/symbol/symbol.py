@@ -981,9 +981,12 @@ class Executor:
                 self.arg_dict[n] = _wrap(arr)
 
     def forward(self, is_train=False, **kwargs):
+        from .. import telemetry as _telemetry
         self._feed_inputs(kwargs)
         key = _random.new_eager_seed_key()
-        outs, aux_updates = self._fwd_fn(bool(is_train))(self._env(), key)
+        with _telemetry.timer("executor.forward").time():
+            outs, aux_updates = self._fwd_fn(bool(is_train))(
+                self._env(), key)
         for n, v in aux_updates.items():
             if n in self.aux_dict:
                 # pinned aux states (BN stats) stay on their ctx-group device
@@ -1126,7 +1129,9 @@ class Executor:
             out_grads = [g._data if isinstance(g, NDArray)
                          else jnp.asarray(g) for g in out_grads]
         key = _random.new_eager_seed_key()
-        _, grads = self._bwd_fn(wrt)(wrt_vals, rest_env, out_grads, key)
+        from .. import telemetry as _telemetry
+        with _telemetry.timer("executor.backward").time():
+            _, grads = self._bwd_fn(wrt)(wrt_vals, rest_env, out_grads, key)
         for n in wrt:
             g = grads[n]
             if g.dtype == jax.dtypes.float0:

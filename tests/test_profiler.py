@@ -45,7 +45,9 @@ def test_trace_capture_and_device_parse(tmp_path):
     x = jnp.ones((128, 128))
     np.asarray(f(x))
     profiler.stop()
-    if profiler._STATE["trace_dir"] is None:
+    # stop() clears the ACTIVE dir and parks the run under last_trace_dir
+    assert profiler._STATE["trace_dir"] is None
+    if profiler._STATE["last_trace_dir"] is None:
         pytest.skip("device tracing unavailable on this backend")
     assert profiler._latest_trace_file(tdir) is not None, \
         "jax.profiler produced no trace export"

@@ -1,0 +1,396 @@
+"""Telemetry registry + structured step log + report CLI.
+
+Covers the PR's observability stack: thread-safe instruments, the JSONL
+step-record schema fed by real Module/gluon train steps, the profiler
+dumps() integration (timer/gauge sections, full reset), device_op_events
+against a synthetic device-plane Chrome trace, monitor -> telemetry event
+routing, kvstore/io instrumentation, and the anomaly flags in
+tools/telemetry_report.py.
+"""
+import gzip
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, profiler, telemetry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import telemetry_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test sees a zeroed registry and a disabled sink."""
+    config.set("telemetry.sink", "")
+    telemetry.reset()
+    yield
+    config.set("telemetry.sink", "")
+    telemetry.reset()
+
+
+# ------------------------------------------------------------- registry
+def test_counter_concurrent_increments():
+    c = telemetry.counter("t.concurrent")
+    threads = [threading.Thread(
+        target=lambda: [c.inc() for _ in range(1000)]) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_scoped_profiler_counter_concurrent_increments():
+    # the profiler.Domain counter (satellite: read-modify-write under lock)
+    c = profiler.Domain("tele").new_counter("races", 0)
+    threads = [threading.Thread(
+        target=lambda: [c.increment(1) for _ in range(500)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 4000
+
+
+def test_timer_stats_and_reservoir():
+    t = telemetry.timer("t.timer")
+    for ms in range(1, 101):
+        t.observe(ms / 1e3)
+    s = t.stats()
+    assert s["count"] == 100
+    assert abs(s["total"] - 5.05) < 1e-6
+    assert s["min"] == 0.001 and s["max"] == 0.1
+    assert 0.045 <= s["p50"] <= 0.055
+    assert 0.095 <= s["p99"] <= 0.1
+    with t.time():
+        pass
+    assert t.stats()["count"] == 101
+
+
+def test_gauge_and_snapshot_dispatch_superset():
+    telemetry.gauge("t.depth").set(5)
+    snap = telemetry.snapshot()
+    assert snap["gauges"]["t.depth"] == 5
+    for name in telemetry.DISPATCH_COUNTERS:
+        assert name in snap["counters"]
+
+
+def test_profiler_counters_delegate_and_reset():
+    profiler.counter_increment("fused_steps", 3)
+    assert profiler.counters()["fused_steps"] == 3
+    assert telemetry.counter("fused_steps").value == 3
+    profiler.reset_counters()
+    assert profiler.counters()["fused_steps"] == 0
+
+
+# ------------------------------------------------------------- step log
+def _run_module_steps(tmp_path, steps=12):
+    log = tmp_path / "steps.jsonl"
+    config.set("module.fused_step", "auto")
+    config.set("telemetry.sink", "jsonl:%s" % log)
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc0")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="head")
+    out = mx.sym.SoftmaxOutput(h, label, name="softmax")
+    mod = mx.mod.Module(out)
+    mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        [mx.nd.array(rng.randn(8, 6).astype(np.float32))],
+        [mx.nd.array((rng.rand(8) * 4).astype(np.float32))])
+    for _ in range(steps):
+        mod.train_step(batch)
+    config.set("telemetry.sink", "")
+    return log
+
+
+def test_step_log_schema_and_paths(tmp_path):
+    log = _run_module_steps(tmp_path, steps=12)
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    steps = [r for r in records if r["event"] == "step"]
+    assert len(steps) == 12
+    for rec in steps:
+        telemetry.validate_step_record(rec)
+        assert rec["source"] == "module"
+        assert rec["path"] == "fused"
+        assert rec["shape"] == [8, 6]
+        assert rec["samples"] == 8
+    assert [r["step"] for r in steps] == list(range(1, 13))
+    # exactly the first step compiled
+    assert [r["compiles"] for r in steps] == [1] + [0] * 11
+
+
+def test_step_log_gluon_source(tmp_path):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    log = tmp_path / "gluon.jsonl"
+    config.set("telemetry.sink", str(log))  # bare-path shorthand
+    assert telemetry.enabled()
+    net = nn.Dense(1, in_units=1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(np.ones((4, 1), np.float32))
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(4)
+    config.set("telemetry.sink", "")
+    steps = [json.loads(l) for l in log.read_text().splitlines()
+             if json.loads(l)["event"] == "step"]
+    assert len(steps) == 3
+    for rec in steps:
+        telemetry.validate_step_record(rec)
+        assert rec["source"] == "gluon"
+        assert rec["path"] == "eager"
+        assert rec["samples"] == 4
+
+
+def test_step_scope_mesh_and_sink_off_noop(tmp_path):
+    log = tmp_path / "mesh.jsonl"
+    config.set("telemetry.sink", "jsonl:%s" % log)
+    with telemetry.step_scope("spmd", samples=16, shape=(16, 3),
+                              mesh={"data": 8}, default_path="fused"):
+        pass
+    config.set("telemetry.sink", "")
+    rec = json.loads(log.read_text().splitlines()[0])
+    telemetry.validate_step_record(rec)
+    assert rec["mesh"] == {"data": 8}
+    assert rec["path"] == "fused"
+    # sink off: scope still feeds the registry but writes nothing
+    with telemetry.step_scope("spmd", samples=16):
+        pass
+    assert len(log.read_text().splitlines()) == 1
+    assert telemetry.counter("spmd.steps").value == 2
+    assert telemetry.timer("spmd.step").stats()["count"] == 2
+
+
+def test_step_scope_exception_writes_no_record(tmp_path):
+    log = tmp_path / "exc.jsonl"
+    config.set("telemetry.sink", "jsonl:%s" % log)
+    with pytest.raises(RuntimeError):
+        with telemetry.step_scope("module", samples=4):
+            raise RuntimeError("boom")
+    config.set("telemetry.sink", "")
+    assert log.read_text() == ""
+    # the timer still observed the failed step
+    assert telemetry.timer("module.step").stats()["count"] == 1
+
+
+def test_validate_step_record_rejects():
+    good = {"event": "step", "ts": 1.0, "source": "module", "step": 1,
+            "path": "fused", "wall_ms": 1.0, "compiles": 0,
+            "host_syncs": 0}
+    telemetry.validate_step_record(dict(good))
+    for broken in (
+            {k: v for k, v in good.items() if k != "wall_ms"},
+            dict(good, step=0),
+            dict(good, event="monitor"),
+            dict(good, compiles=True),
+            dict(good, shape="8x6")):
+        with pytest.raises(ValueError):
+            telemetry.validate_step_record(broken)
+
+
+def test_monitor_events_route_to_sink(tmp_path):
+    log = tmp_path / "mon.jsonl"
+    config.set("telemetry.sink", "jsonl:%s" % log)
+    mon = mx.monitor.Monitor(interval=1, pattern=".*weight")
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    exe = out.simple_bind(data=(2, 4))
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    config.set("telemetry.sink", "")
+    assert res, "monitor collected no stats"
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    mon_events = [e for e in events if e["event"] == "monitor"]
+    assert len(mon_events) == len(res)
+    for e in mon_events:
+        assert set(e) >= {"event", "ts", "step", "name", "stat"}
+
+
+# --------------------------------------------------- subsystem counters
+def test_kvstore_push_pull_counters():
+    kv = mx.kv.create("local")
+    v = mx.nd.array(np.ones((4, 4), np.float32))
+    kv.init("w", v)
+    base_push = telemetry.counter("kvstore.push_calls").value
+    base_bytes = telemetry.counter("kvstore.push_bytes").value
+    kv.push("w", v)
+    out = mx.nd.array(np.zeros((4, 4), np.float32))
+    kv.pull("w", out=out)
+    assert telemetry.counter("kvstore.push_calls").value == base_push + 1
+    assert telemetry.counter("kvstore.pull_calls").value >= 1
+    assert telemetry.counter("kvstore.push_bytes").value \
+        == base_bytes + 4 * 4 * 4
+    assert telemetry.counter("kvstore.pull_bytes").value >= 4 * 4 * 4
+
+
+def test_io_batch_fetch_timer():
+    it = mx.io.NDArrayIter(
+        data=np.zeros((8, 2), np.float32),
+        label=np.zeros((8,), np.float32), batch_size=4)
+    before = telemetry.timer("io.batch_fetch").stats()["count"]
+    n = sum(1 for _ in it)
+    assert n == 2
+    assert telemetry.timer("io.batch_fetch").stats()["count"] == before + n
+
+
+# ------------------------------------------------------- profiler UX
+def test_dumps_sections_and_full_reset(tmp_path):
+    _run_module_steps(tmp_path, steps=4)
+    telemetry.gauge("io.prefetch_queue_depth").set(2)
+    text = profiler.dumps()
+    assert "Telemetry timers" in text
+    assert "module.step" in text
+    assert "Gauges" in text
+    assert "io.prefetch_queue_depth" in text
+    assert "fused_steps" in text
+    # reset=True zeroes dispatch counters AND timer histograms
+    profiler.dumps(reset=True)
+    assert profiler.counters()["fused_steps"] == 0
+    assert telemetry.timer("module.step").stats()["count"] == 0
+    assert telemetry.gauge("io.prefetch_queue_depth").value == 0
+
+
+def test_trace_dir_cleared_after_stop_with_escape_hatch(tmp_path):
+    """satellite 2: stop() must not leave the active trace_dir stale."""
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        trace_dir=str(tmp_path / "xp"))
+    profiler.start()
+    profiler.stop()
+    assert profiler._STATE["trace_dir"] is None
+    # a fresh start() forgets the previous run: no implicit stale reads
+    profiler.start()
+    assert profiler._STATE["last_trace_dir"] is None
+    profiler.stop()
+
+
+def _write_synthetic_trace(tdir):
+    run = os.path.join(tdir, "plugins", "profile", "run1")
+    os.makedirs(run)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "python host thread"}},
+        {"ph": "X", "pid": 7, "tid": 0, "name": "fusion.1",
+         "ts": 0, "dur": 1500},
+        {"ph": "X", "pid": 7, "tid": 0, "name": "fusion.1",
+         "ts": 2000, "dur": 500},
+        {"ph": "X", "pid": 7, "tid": 0, "name": "copy.2",
+         "ts": 3000, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "host_only_op",
+         "ts": 0, "dur": 9000},
+    ]
+    path = os.path.join(run, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_device_op_events_synthetic_device_plane(tmp_path):
+    """device_op_events must pick device-plane X events by process
+    metadata and exclude host pids (tested with a fake TPU plane, since
+    the CPU backend exports no real one)."""
+    tdir = str(tmp_path / "trace")
+    _write_synthetic_trace(tdir)
+    dev = profiler.device_op_events(tdir)
+    assert set(dev) == {"fusion.1", "copy.2"}
+    assert dev["fusion.1"] == [0.0015, 0.0005]
+    assert "host_only_op" not in dev
+
+
+# ---------------------------------------------------------- report CLI
+def _step(source, step, wall_ms, compiles=0, sps=None, shape=(8, 6)):
+    return {"event": "step", "ts": 1000.0 + step, "source": source,
+            "step": step, "path": "fused", "wall_ms": wall_ms,
+            "samples": 8, "samples_per_s": sps, "compiles": compiles,
+            "host_syncs": 0, "mem_bytes": 1024,
+            "shape": list(shape), "mesh": None}
+
+
+def test_report_clean_run_no_flags():
+    records = [_step("module", i, 5.0 + (i % 3) * 0.1, sps=1000.0)
+               for i in range(1, 21)]
+    records[0]["compiles"] = 1
+    s = telemetry_report.summarize(records)
+    assert s["anomalies"] == []
+    t = s["sources"]["module"]
+    assert t["steps"] == 20 and t["compiles"] == 1
+    assert t["distinct_shapes"] == 1
+
+
+def test_report_flags_recompile_churn():
+    records = [_step("module", i, 5.0, compiles=1) for i in range(1, 6)]
+    s = telemetry_report.summarize(records)
+    kinds = {a["kind"] for a in s["anomalies"]}
+    assert "recompile_churn" in kinds
+
+
+def test_report_flags_latency_blowup():
+    records = [_step("module", i, 5.0, sps=1000.0) for i in range(1, 20)]
+    records.append(_step("module", 20, 500.0, sps=1000.0))
+    s = telemetry_report.summarize(records)
+    kinds = {a["kind"] for a in s["anomalies"]}
+    assert "latency_blowup" in kinds
+
+
+def test_report_flags_falling_throughput():
+    records = [_step("module", i, 5.0, sps=1000.0) for i in range(1, 11)]
+    records += [_step("module", i, 5.0, sps=200.0) for i in range(11, 21)]
+    s = telemetry_report.summarize(records)
+    kinds = {a["kind"] for a in s["anomalies"]}
+    assert "falling_throughput" in kinds
+
+
+def test_report_cli_renders_and_strict_gate(tmp_path):
+    log = tmp_path / "r.jsonl"
+    with open(log, "w") as f:
+        for i in range(1, 6):
+            f.write(json.dumps(_step("module", i, 5.0, compiles=1)) + "\n")
+        f.write("{half-written garbage\n")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "telemetry_report.py"), str(log)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "recompile_churn" in out.stdout
+    assert "malformed lines skipped: 1" in out.stdout
+    strict = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "telemetry_report.py"), str(log), "--strict"],
+        capture_output=True, text=True, timeout=60)
+    assert strict.returncode == 1
+
+
+def test_check_telemetry_smoke():
+    """Subprocess wiring for tools/check_telemetry.py — the pipeline must
+    hold from a clean interpreter, exactly how CI invokes it."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "check_telemetry.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=root)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"], report
+    assert report["summary"]["steps"] == 20, report
+    assert report["summary"]["paths"] == {"fused": 20}, report

@@ -1,0 +1,206 @@
+"""Summarize a telemetry JSONL step log into per-phase tables + anomalies.
+
+Input is the file written by ``MXNET_TPU_TELEMETRY=jsonl:<path>`` (see
+docs/OBSERVABILITY.md for the record schema).  Pure stdlib — runs anywhere
+the log file can be copied, no framework import needed.
+
+Per-source ("module"/"spmd"/"gluon") phase table: step count, wall-time
+mean/p50/p99 (ms), mean throughput, total recompiles and host syncs, peak
+device memory.  Anomaly flags:
+
+  * recompile churn — more fused compiles than distinct batch-shape
+    signatures: something retraces at a fixed shape (knob epoch bumps,
+    weak-typed scalars, python-side cache misses);
+  * latency blowup  — p99/p50 wall time > 3x over >= 10 steady-state steps
+    (steps that compiled are excluded — first-step compile is an expected
+    straggler): host sync stalls or input pipeline hiccups dominate the
+    tail;
+  * falling throughput — second-half mean samples/s < 70% of first-half
+    over >= 10 steps: the run is slowing down (leak, growing host work).
+
+Usage:
+  python tools/telemetry_report.py RUN.jsonl          # tables + flags
+  python tools/telemetry_report.py RUN.jsonl --json   # machine-readable
+Exit code is 0 either way; anomalies are report content, not errors
+(--strict makes them exit 1 for CI gates).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+P99_P50_RATIO = 3.0
+LATENCY_FLOOR_MS = 10.0  # sub-10ms tails are scheduler noise, not stalls
+THROUGHPUT_DROP = 0.7
+MIN_STEPS_FOR_FLAGS = 10
+
+
+def load_records(path):
+    """Parse a JSONL file; malformed lines are counted, not fatal (a live
+    run's last line may be half-written)."""
+    records, bad = [], 0
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    return records, bad
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    i = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def summarize(records):
+    """Reduce parsed records to {"sources": {name: table}, "anomalies":
+    [...], "monitor_events": int, "other_events": int}.  Used by the CLI
+    and by tools/check_telemetry.py's no-anomalies assertion."""
+    steps = [r for r in records if r.get("event") == "step"]
+    monitor_events = sum(1 for r in records if r.get("event") == "monitor")
+    other = len(records) - len(steps) - monitor_events
+
+    sources = {}
+    anomalies = []
+    by_source = {}
+    for r in steps:
+        by_source.setdefault(r.get("source", "?"), []).append(r)
+
+    for source in sorted(by_source):
+        recs = by_source[source]
+        walls = sorted(float(r["wall_ms"]) for r in recs
+                       if isinstance(r.get("wall_ms"), (int, float)))
+        # steady-state wall times: steps that compiled are expected
+        # stragglers, so percentiles (and the latency flag) exclude them
+        steady = sorted(float(r["wall_ms"]) for r in recs
+                        if isinstance(r.get("wall_ms"), (int, float))
+                        and not r.get("compiles")) or walls
+        sps = [float(r["samples_per_s"]) for r in recs
+               if isinstance(r.get("samples_per_s"), (int, float))]
+        compiles = sum(int(r.get("compiles") or 0) for r in recs)
+        syncs = sum(int(r.get("host_syncs") or 0) for r in recs)
+        mems = [int(r["mem_bytes"]) for r in recs
+                if isinstance(r.get("mem_bytes"), int)]
+        paths = {}
+        for r in recs:
+            p = r.get("path", "?")
+            paths[p] = paths.get(p, 0) + 1
+        shapes = {tuple(r["shape"]) for r in recs
+                  if isinstance(r.get("shape"), list)}
+        p50 = _pct(steady, 50)
+        p99 = _pct(steady, 99)
+        table = {
+            "steps": len(recs),
+            "paths": paths,
+            "wall_ms_mean": round(sum(walls) / len(walls), 3)
+            if walls else None,
+            "wall_ms_p50": round(p50, 3) if p50 is not None else None,
+            "wall_ms_p99": round(p99, 3) if p99 is not None else None,
+            "samples_per_s_mean": round(sum(sps) / len(sps), 1)
+            if sps else None,
+            "compiles": compiles,
+            "host_syncs": syncs,
+            "peak_mem_bytes": max(mems) if mems else None,
+            "distinct_shapes": len(shapes),
+        }
+        sources[source] = table
+
+        # recompile churn: each distinct feed signature legitimately costs
+        # one compile; anything beyond that is retracing at a fixed shape
+        expected = max(1, len(shapes))
+        if compiles > expected:
+            anomalies.append({
+                "kind": "recompile_churn", "source": source,
+                "detail": "%d compiles for %d distinct batch shape(s)"
+                          % (compiles, expected)})
+        if (len(steady) >= MIN_STEPS_FOR_FLAGS and p50 and
+                p99 >= LATENCY_FLOOR_MS and p99 / p50 > P99_P50_RATIO):
+            anomalies.append({
+                "kind": "latency_blowup", "source": source,
+                "detail": "p99 %.3fms / p50 %.3fms = %.1fx (> %.1fx)"
+                          % (p99, p50, p99 / p50, P99_P50_RATIO)})
+        if len(sps) >= MIN_STEPS_FOR_FLAGS:
+            half = len(sps) // 2
+            first = sum(sps[:half]) / half
+            second = sum(sps[half:]) / (len(sps) - half)
+            if first > 0 and second < THROUGHPUT_DROP * first:
+                anomalies.append({
+                    "kind": "falling_throughput", "source": source,
+                    "detail": "second-half %.1f samples/s vs first-half "
+                              "%.1f (< %d%%)" % (second, first,
+                                                 THROUGHPUT_DROP * 100)})
+
+    return {"sources": sources, "anomalies": anomalies,
+            "monitor_events": monitor_events, "other_events": other}
+
+
+def _fmt(v, suffix=""):
+    return "-" if v is None else ("%s%s" % (v, suffix))
+
+
+def render(summary, bad_lines=0):
+    lines = []
+    header = ("%-8s %6s %10s %10s %10s %12s %8s %6s %12s %7s"
+              % ("source", "steps", "mean_ms", "p50_ms", "p99_ms",
+                 "samples/s", "compile", "syncs", "peak_mem", "shapes"))
+    lines.append(header)
+    lines.append("-" * len(header))
+    for source, t in summary["sources"].items():
+        lines.append("%-8s %6d %10s %10s %10s %12s %8d %6d %12s %7d"
+                     % (source, t["steps"], _fmt(t["wall_ms_mean"]),
+                        _fmt(t["wall_ms_p50"]), _fmt(t["wall_ms_p99"]),
+                        _fmt(t["samples_per_s_mean"]), t["compiles"],
+                        t["host_syncs"], _fmt(t["peak_mem_bytes"]),
+                        t["distinct_shapes"]))
+        path_str = ", ".join("%s=%d" % kv for kv in
+                             sorted(t["paths"].items()))
+        lines.append("         paths: %s" % path_str)
+    if not summary["sources"]:
+        lines.append("(no step records)")
+    if summary["monitor_events"]:
+        lines.append("monitor events: %d" % summary["monitor_events"])
+    if summary["other_events"]:
+        lines.append("other events: %d" % summary["other_events"])
+    if bad_lines:
+        lines.append("malformed lines skipped: %d" % bad_lines)
+    lines.append("")
+    if summary["anomalies"]:
+        lines.append("ANOMALIES:")
+        for a in summary["anomalies"]:
+            lines.append("  [%s] %s: %s"
+                         % (a["kind"], a["source"], a["detail"]))
+    else:
+        lines.append("no anomalies detected")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize an MXNET_TPU_TELEMETRY JSONL step log.")
+    ap.add_argument("log", help="path to the JSONL file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any anomaly is flagged (CI gate)")
+    args = ap.parse_args(argv)
+
+    records, bad = load_records(args.log)
+    summary = summarize(records)
+    if args.json:
+        summary["malformed_lines"] = bad
+        print(json.dumps(summary))
+    else:
+        print(render(summary, bad))
+    return 1 if (args.strict and summary["anomalies"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
