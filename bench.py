@@ -402,6 +402,22 @@ def module_train_config(runs_out, fused_iters, eager_iters):
             runs_out.append({
                 "mode": "module_train", "path": "telemetry_overhead",
                 "overhead_pct": round((fused - fused_tel) / fused * 100, 2)})
+        # tracing-overhead guard: same contract for the causal-span chrome
+        # sink (MXNET_TPU_TRACE) — span enter/exit plus one JSON line per
+        # span must stay in the same few-% envelope
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="mxtpu_bench_trace_"), "run.trace.json")
+        try:
+            _cfg.set("tracing.sink", "chrome:" + trace_path)
+            fused_trace = one_path("fused", fused_iters,
+                                   label="fused_tracing")
+        finally:
+            _cfg.set("tracing.sink", "")
+        if fused > 0 and fused_trace > 0:
+            runs_out.append({
+                "mode": "module_train", "path": "tracing_overhead",
+                "overhead_pct":
+                    round((fused - fused_trace) / fused * 100, 2)})
     finally:
         _cfg.set("module.fused_step", "auto")
 
@@ -438,6 +454,10 @@ def _summarize(runs):
             secondary["module_mlp_train_throughput"][
                 "telemetry_overhead_pct"] = \
                 mod_runs["telemetry_overhead"]["overhead_pct"]
+        if "tracing_overhead" in mod_runs:
+            secondary["module_mlp_train_throughput"][
+                "tracing_overhead_pct"] = \
+                mod_runs["tracing_overhead"]["overhead_pct"]
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",
         "value": best["img_s"],

@@ -47,6 +47,7 @@ _LAZY = {
     "model": ".model",
     "profiler": ".profiler",
     "telemetry": ".telemetry",
+    "tracing": ".tracing",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "parallel": ".parallel",

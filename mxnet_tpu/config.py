@@ -216,6 +216,51 @@ def _apply_telemetry_sink(value):
 
 _ON_SET["telemetry.sink"] = _apply_telemetry_sink
 
+# causal tracing + hang watchdog (docs/OBSERVABILITY.md)
+register_knob(
+    "tracing.sink", "MXNET_TPU_TRACE", str, "",
+    "causal span trace sink: 'chrome:<path>' streams framework spans "
+    "(step/fwd/bwd/opt-update/prefetch/push/pull/allreduce, with "
+    "contextvars-propagated parent/child links that survive thread hops) "
+    "as Chrome trace-event JSON; merge with a jax.profiler device capture "
+    "via tools/trace_merge.py. Empty (default) disables — span() is a "
+    "shared no-op when no sink/watchdog/device trace is active.")
+register_knob(
+    "tracing.watchdog", "MXNET_TPU_WATCHDOG", float, 0.0,
+    "hang-watchdog deadline in seconds: > 0 starts a daemon thread that, "
+    "when no train step completes within the deadline, dumps thread "
+    "stacks, open spans with ages, the flight-recorder event ring, device "
+    "memory and gauge snapshots to a timestamped watchdog_report_*.json — "
+    "then keeps the job running. 0 (default) disables.")
+register_knob(
+    "tracing.watchdog_dir", "MXNET_TPU_WATCHDOG_DIR", str, "",
+    "directory for watchdog flight-recorder reports; empty (default) = "
+    "the current working directory.")
+register_knob(
+    "tracing.ring_size", "MXNET_TPU_TRACE_RING", int, 256,
+    "flight-recorder bound: how many recent span/step events the "
+    "in-memory ring keeps for the watchdog report.")
+
+
+def _apply_tracing_sink(value):
+    from . import tracing
+    tracing.configure_sink(value)
+
+
+def _apply_tracing_watchdog(value):
+    from . import tracing
+    tracing.configure_watchdog(value, report_dir=get("tracing.watchdog_dir"))
+
+
+def _apply_tracing_ring(value):
+    from . import tracing
+    tracing.configure_ring(value)
+
+
+_ON_SET["tracing.sink"] = _apply_tracing_sink
+_ON_SET["tracing.watchdog"] = _apply_tracing_watchdog
+_ON_SET["tracing.ring_size"] = _apply_tracing_ring
+
 # kvstore / gradient sync
 register_knob(
     "kvstore.grad_compression_threshold",

@@ -142,18 +142,24 @@ class Trainer:
         """Makes one step of parameter update
         (reference: trainer.py:305).  Feeds the ``gluon.step`` telemetry
         timer; with the JSONL step log on, emits one step record (path
-        "eager" — the per-parameter updater loop) per call."""
+        "eager" — the per-parameter updater loop) per call.  Opens a
+        ``gluon.step`` causal span with ``gluon.allreduce`` /
+        ``gluon.opt_update`` children (docs/OBSERVABILITY.md)."""
         from .. import telemetry as _telemetry
+        from .. import tracing as _tracing
         with _telemetry.step_scope("gluon", samples=int(batch_size),
-                                   default_path="eager"):
+                                   default_path="eager"), \
+                _tracing.span("gluon.step", cat="gluon"):
             rescale_grad = self._scale / batch_size
             self._check_and_rescale_grad(rescale_grad)
             if not self._kv_initialized:
                 self._init_kvstore()
             if self._params_to_init:
                 self._init_params()
-            self._allreduce_grads()
-            self._update(ignore_stale_grad)
+            with _tracing.span("gluon.allreduce", cat="gluon"):
+                self._allreduce_grads()
+            with _tracing.span("gluon.opt_update", cat="gluon"):
+                self._update(ignore_stale_grad)
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kv_initialized and self._kvstore:

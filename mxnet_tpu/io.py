@@ -339,16 +339,22 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _start(self):
+        from . import tracing as _tracing
+
         def worker():
             while not self._stop.is_set():
                 try:
-                    batches = [it.next() for it in self.iters]
+                    with _tracing.span("io.prefetch", cat="io"):
+                        batches = [it.next() for it in self.iters]
                 except StopIteration:
                     self._queue.put(None)
                     return
                 self._queue.put(batches[0] if len(batches) == 1 else batches)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        # wrap_context snapshots the caller's contextvars so prefetch spans
+        # keep the parent trace id across the thread hop
+        self._thread = threading.Thread(
+            target=_tracing.wrap_context(worker), daemon=True)
         self._thread.start()
 
     @property

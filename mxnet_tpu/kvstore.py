@@ -148,9 +148,14 @@ class KVStore:
         """Pushes (aggregates) value(s) into the store
         (reference: kvstore.py:178; KVStoreLocal::PushImpl kvstore_local.h:206).
         """
+        from . import tracing as _tracing
         keys, values = _normalize_push(key, value)
         _telemetry.counter("kvstore.push_calls").inc()
         _telemetry.counter("kvstore.push_bytes").inc(_payload_bytes(values))
+        with _tracing.span("kvstore.push", cat="kvstore", keys=len(keys)):
+            self._push_impl(keys, values)
+
+    def _push_impl(self, keys, values):
         for k, v in zip(keys, values):
             merged = self._merge(v)
             payload, compressed = self._compress(k, merged)
@@ -170,14 +175,16 @@ class KVStore:
         """Pulls value(s) from the store into out
         (reference: kvstore.py:248)."""
         assert out is not None
+        from . import tracing as _tracing
         keys, outs = _normalize_push(key, out)
         _telemetry.counter("kvstore.pull_calls").inc()
         _telemetry.counter("kvstore.pull_bytes").inc(_payload_bytes(outs))
-        for k, o in zip(keys, outs):
-            src = self._store[k]
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            for t in targets:
-                t._data = jnp.asarray(src._data, t._data.dtype)
+        with _tracing.span("kvstore.pull", cat="kvstore", keys=len(keys)):
+            for k, o in zip(keys, outs):
+                src = self._store[k]
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._data = jnp.asarray(src._data, t._data.dtype)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Combined push and pull (reference: kvstore.py:290)."""

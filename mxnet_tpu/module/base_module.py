@@ -68,11 +68,15 @@ class BaseModule:
         Module runs this as ONE fused jitted program when eligible (see
         Module's PERFORMANCE NOTE); elsewhere it is the literal two-stage
         reference sequence.  Each step feeds the ``module.step`` telemetry
-        timer, and one JSONL step record (path fused/eager, compile and
-        host-sync deltas, throughput) when the step log is enabled
-        (docs/OBSERVABILITY.md)."""
+        timer, one JSONL step record (path fused/eager, compile and
+        host-sync deltas, throughput; an ``error`` field if the step body
+        raised) when the step log is enabled, and opens a ``module.step``
+        causal span — the per-step trace root the fwd/bwd/opt-update child
+        spans hang off (docs/OBSERVABILITY.md)."""
         from .. import telemetry as _telemetry
-        with _telemetry.step_scope("module", batch=data_batch):
+        from .. import tracing as _tracing
+        with _telemetry.step_scope("module", batch=data_batch), \
+                _tracing.span("module.step", cat="module"):
             self.forward_backward(data_batch)
             self.update()
 

@@ -256,8 +256,10 @@ class Module(BaseModule):
         # an observed deferral costs a full eager fwd+bwd replay — a rising
         # count means something inspects state between fused steps
         from .. import telemetry as _telemetry
+        from .. import tracing as _tracing
         _telemetry.counter("module.eager_replays").inc()
-        BaseModule.forward_backward(self, batch)
+        with _tracing.span("module.eager_replay", cat="module"):
+            BaseModule.forward_backward(self, batch)
 
     def _run_fused(self, data_batch):
         """One donated jit dispatch: forward + backward + optimizer update
@@ -364,18 +366,22 @@ class Module(BaseModule):
         sharded step, a no-op on one chip).  A batch deferred by
         forward_backward is consumed here as ONE fused jit dispatch."""
         assert self.optimizer_initialized
+        from .. import tracing as _tracing
         batch = self._pending_batch
         if batch is not None:
             self._pending_batch = None
-            self._run_fused(batch)
+            # one donated jit program: fwd + bwd + optimizer update
+            with _tracing.span("module.fused_dispatch", cat="module"):
+                self._run_fused(batch)
             return
         from .. import profiler as _profiler
         _profiler.counter_increment("eager_steps")
-        for i, name in enumerate(self._param_names):
-            g = self._exec.grad_dict.get(name)
-            if g is None:
-                continue
-            self._updater(i, g, self._exec.arg_dict[name])
+        with _tracing.span("module.opt_update", cat="module"):
+            for i, name in enumerate(self._param_names):
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._updater(i, g, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
         self._flush_pending()

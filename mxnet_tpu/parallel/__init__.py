@@ -77,8 +77,10 @@ def host_allreduce(val):
     if jax.process_count() == 1:
         return val
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(jnp.asarray(val))
-    return jnp.sum(gathered, axis=0)
+    from .. import tracing as _tracing
+    with _tracing.span("allreduce", cat="collective"):
+        gathered = multihost_utils.process_allgather(jnp.asarray(val))
+        return jnp.sum(gathered, axis=0)
 
 
 def barrier(name="kvstore"):
@@ -87,4 +89,6 @@ def barrier(name="kvstore"):
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    from .. import tracing as _tracing
+    with _tracing.span("barrier", cat="collective", name_arg=name):
+        multihost_utils.sync_global_devices(name)

@@ -307,6 +307,7 @@ class SPMDTrainer:
         async device work overlaps the next step by design."""
         from ..ndarray.ndarray import NDArray
         from .. import telemetry as _telemetry
+        from .. import tracing as _tracing
         if isinstance(data, NDArray):
             data = data._data
         if isinstance(label, NDArray):
@@ -317,18 +318,25 @@ class SPMDTrainer:
                 shape=tuple(getattr(data, "shape", ())) or None,
                 mesh={n: int(s) for n, s in zip(self.mesh.axis_names,
                                                 self.mesh.devices.shape)},
-                default_path="fused"):
+                default_path="fused"), \
+                _tracing.span("spmd.step", cat="spmd"):
             return self._step_impl(data, label, lr_scale)
 
     def _step_impl(self, data, label, lr_scale):
+        from .. import tracing as _tracing
         if self.params is None:
             self._materialize(data)
         if self._jitted is None:
-            self._jitted = self._build()
+            with _tracing.span("spmd.compile", cat="spmd"):
+                self._jitted = self._build()
             from .. import profiler as _profiler
             _profiler.counter_increment("fused_compiles")
-        data = jax.device_put(jnp.asarray(data), self._batch_sharding)
-        label = jax.device_put(jnp.asarray(label), self._batch_sharding)
+        # the batch shard_put is the host->mesh boundary; the gradient
+        # allreduce itself is a compiler-scheduled psum INSIDE the jitted
+        # step (visible on the device plane of a merged trace, not here)
+        with _tracing.span("spmd.shard_batch", cat="spmd"):
+            data = jax.device_put(jnp.asarray(data), self._batch_sharding)
+            label = jax.device_put(jnp.asarray(label), self._batch_sharding)
         self._step_num += 1
         self.optimizer.num_update = self._step_num
         if not hasattr(self, "_hyper_cache"):

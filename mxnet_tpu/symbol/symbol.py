@@ -982,9 +982,11 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         from .. import telemetry as _telemetry
+        from .. import tracing as _tracing
         self._feed_inputs(kwargs)
         key = _random.new_eager_seed_key()
-        with _telemetry.timer("executor.forward").time():
+        with _telemetry.timer("executor.forward").time(), \
+                _tracing.span("executor.forward", cat="executor"):
             outs, aux_updates = self._fwd_fn(bool(is_train))(
                 self._env(), key)
         for n, v in aux_updates.items():
@@ -1130,7 +1132,9 @@ class Executor:
                          else jnp.asarray(g) for g in out_grads]
         key = _random.new_eager_seed_key()
         from .. import telemetry as _telemetry
-        with _telemetry.timer("executor.backward").time():
+        from .. import tracing as _tracing
+        with _telemetry.timer("executor.backward").time(), \
+                _tracing.span("executor.backward", cat="executor"):
             _, grads = self._bwd_fn(wrt)(wrt_vals, rest_env, out_grads, key)
         for n in wrt:
             g = grads[n]

@@ -28,7 +28,8 @@ docs/OBSERVABILITY.md)::
      "wall_ms": <float>, "samples": <int|null>, "samples_per_s":
      <float|null>, "compiles": <fused_compiles delta>, "host_syncs":
      <host_syncs delta>, "mem_bytes": <device watermark|null>,
-     "shape": <batch shape|null>, "mesh": {axis: size}|null}
+     "shape": <batch shape|null>, "mesh": {axis: size}|null,
+     "error": "<ExcType: message>" (only on steps whose body raised)}
 
 ``tools/telemetry_report.py`` summarizes a run into per-phase tables and
 flags anomalies (recompile churn at fixed shape, p99/p50 blowup, falling
@@ -55,6 +56,12 @@ _GAUGES = {}
 _TIMERS = {}
 
 STEP_SOURCES = ("module", "spmd", "gluon")
+
+#: set by mx.tracing at import: called as hook(source, step, wall_s,
+#: error=None) after EVERY train step (success or failure) — the hang
+#: watchdog's liveness signal and the flight recorder's step feed.  A slot
+#: rather than an import so telemetry never depends on tracing.
+_TRACING_STEP_HOOK = None
 
 #: the PR-1 dispatch counters now live on this registry (profiler.counters()
 #: reads them back from here); listed so snapshots always carry all four
@@ -354,8 +361,20 @@ class step_scope:
         dt = time.perf_counter() - self._t0
         timer(self.source + ".step").observe(dt)
         idx = counter(self.source + ".steps").inc()
-        if self._before is None or exc_type is not None:
+        error = None
+        if exc_type is not None:
+            counter(self.source + ".step_errors").inc()
+            error = "%s: %s" % (exc_type.__name__, exc)
+        hook = _TRACING_STEP_HOOK
+        if hook is not None:
+            # watchdog liveness + flight recorder: failures included, so a
+            # crash-looping job is distinguishable from a hung one
+            hook(self.source, idx, dt, error=error)
+        if self._before is None:
             return False
+        # a FAILING step still leaves a JSONL record (with its error) — the
+        # log from a crashed run must show the step that died, not end one
+        # line before the truth
         fused_d = counter("fused_steps").value - self._before[0]
         eager_d = counter("eager_steps").value - self._before[1]
         if fused_d > 0:
@@ -365,8 +384,7 @@ class step_scope:
         else:
             path = self.default_path or "unknown"
         samples = self.samples
-        log_event(
-            "step",
+        fields = dict(
             source=self.source,
             step=idx,
             path=path,
@@ -380,6 +398,9 @@ class step_scope:
             shape=list(self.shape) if self.shape else None,
             mesh=dict(self.mesh) if self.mesh else None,
         )
+        if error is not None:
+            fields["error"] = error
+        log_event("step", **fields)
         return False
 
 
@@ -412,7 +433,8 @@ _STEP_REQUIRED = {"event": str, "ts": (int, float), "source": str,
                   "step": int, "path": str, "wall_ms": (int, float),
                   "compiles": int, "host_syncs": int}
 _STEP_OPTIONAL = {"samples": int, "samples_per_s": (int, float),
-                  "mem_bytes": int, "shape": list, "mesh": dict}
+                  "mem_bytes": int, "shape": list, "mesh": dict,
+                  "error": str}
 
 
 def validate_step_record(rec):
@@ -446,3 +468,9 @@ try:
     configure_sink(_config.get("telemetry.sink"))
 except KeyError:  # pragma: no cover — config stripped of the knob
     pass
+
+# mx.tracing registers the step hook and honors MXNET_TPU_TRACE /
+# MXNET_TPU_WATCHDOG at ITS import; pulling it in here means any
+# training-path import (io/module/kvstore all import telemetry) activates
+# the tracing env vars too
+from . import tracing as _tracing  # noqa: E402,F401

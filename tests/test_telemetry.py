@@ -178,16 +178,22 @@ def test_step_scope_mesh_and_sink_off_noop(tmp_path):
     assert telemetry.timer("spmd.step").stats()["count"] == 2
 
 
-def test_step_scope_exception_writes_no_record(tmp_path):
+def test_step_scope_exception_still_emits_record(tmp_path):
+    """A failing step must leave a JSONL record carrying the error — the
+    crash is exactly when the log matters most."""
     log = tmp_path / "exc.jsonl"
     config.set("telemetry.sink", "jsonl:%s" % log)
     with pytest.raises(RuntimeError):
         with telemetry.step_scope("module", samples=4):
             raise RuntimeError("boom")
     config.set("telemetry.sink", "")
-    assert log.read_text() == ""
-    # the timer still observed the failed step
+    rec = json.loads(log.read_text().splitlines()[0])
+    telemetry.validate_step_record(rec)
+    assert rec["error"] == "RuntimeError: boom"
+    assert rec["source"] == "module" and rec["step"] == 1
+    # the timer and the error counter observed the failed step
     assert telemetry.timer("module.step").stats()["count"] == 1
+    assert telemetry.counter("module.step_errors").value == 1
 
 
 def test_validate_step_record_rejects():
@@ -357,6 +363,23 @@ def test_report_flags_falling_throughput():
     s = telemetry_report.summarize(records)
     kinds = {a["kind"] for a in s["anomalies"]}
     assert "falling_throughput" in kinds
+
+
+def test_report_skips_non_dict_lines(tmp_path):
+    """A line truncated to VALID json of the wrong shape ("12" from a cut
+    "wall_ms": 12...) must count as malformed, not crash summarize."""
+    log = tmp_path / "trunc.jsonl"
+    with open(log, "w") as f:
+        f.write(json.dumps(_step("module", 1, 5.0)) + "\n")
+        f.write("12\n")                      # scalar — valid json, no dict
+        f.write("[1, 2]\n")                  # array — same
+        f.write('{"event": "step", "wall\n')  # classic half-written line
+        f.write(json.dumps(_step("module", 2, 5.0)) + "\n")
+    records, bad = telemetry_report.load_records(str(log))
+    assert bad == 3
+    assert len(records) == 2
+    s = telemetry_report.summarize(records)  # must not raise
+    assert s["sources"]["module"]["steps"] == 2
 
 
 def test_report_cli_renders_and_strict_gate(tmp_path):

@@ -38,7 +38,10 @@ MIN_STEPS_FOR_FLAGS = 10
 
 def load_records(path):
     """Parse a JSONL file; malformed lines are counted, not fatal (a live
-    run's last line may be half-written)."""
+    run's last line may be half-written).  A truncated line can still be
+    VALID json of the wrong shape — ``{"event": "step", "wall_ms": 12`` cut
+    at ``12`` parses as the scalar 12 — so anything that isn't a dict is
+    counted as malformed too instead of crashing ``summarize``."""
     records, bad = [], 0
     with open(path, "r") as f:
         for line in f:
@@ -46,8 +49,13 @@ def load_records(path):
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                obj = json.loads(line)
             except ValueError:
+                bad += 1
+                continue
+            if isinstance(obj, dict):
+                records.append(obj)
+            else:
                 bad += 1
     return records, bad
 

@@ -1,0 +1,188 @@
+"""Merge a host span trace with a device profiler trace into ONE timeline.
+
+Inputs:
+
+  * HOST  — the Chrome trace written by ``MXNET_TPU_TRACE=chrome:<path>``
+    (mxnet_tpu.tracing's line-oriented array format; a truncated file from
+    a killed job still loads);
+  * DEVICE — a jax.profiler capture: either a ``*.trace.json[.gz]`` file or
+    the trace DIRECTORY passed to ``profiler.start()`` (the newest
+    ``plugins/profile/*/*.trace.json.gz`` export inside it is used).
+
+Output is a single Chrome trace (load in ui.perfetto.dev or
+chrome://tracing) with the two planes kept distinct:
+
+  * host spans keep their thread lanes under pid 1 ("mxnet_tpu host");
+  * device planes (process_name containing "/device:" etc. — the same
+    heuristic profiler.device_op_events uses) are re-pid'd to 1000+orig;
+    host-side python/TSL lanes inside the profiler export are dropped (the
+    span trace is the host plane — keeping both would show every step
+    twice).
+
+The two captures use different clocks (tracing.py stamps epoch-anchored
+perf_counter µs; the XLA export counts from its own session start), so by
+default each plane is shifted so its earliest event sits at t=0 — start the
+device capture and the span sink together and the planes line up to within
+clock-sync error.  ``--align none`` keeps raw timestamps, ``--align epoch``
+shifts ONLY the device plane by (host_min - device_min) leaving host spans
+on wall-clock time.
+
+Pure stdlib — runs anywhere the two files can be copied.
+
+Usage:
+  python tools/trace_merge.py RUN.trace.json /tmp/xplane_dir -o merged.json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+HOST_PID = 1
+DEVICE_PID_BASE = 1000
+
+
+# --------------------------------------------------------------- loading
+def load_chrome_trace(path):
+    """Lenient Chrome-trace loader: gz or plain, object or bare array, and
+    the truncated line-array form a killed job leaves behind."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        # truncated array: parse line by line, tolerating the cut tail
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict):
+                events.append(e)
+        return events
+    if isinstance(obj, dict):
+        return obj.get("traceEvents", [])
+    if isinstance(obj, list):
+        return [e for e in obj if isinstance(e, dict)]
+    return []
+
+
+def resolve_device_trace(path):
+    """Accept a trace file or a jax.profiler trace dir (newest export)."""
+    if os.path.isdir(path):
+        files = glob.glob(os.path.join(path, "plugins", "profile", "*",
+                                       "*.trace.json.gz"))
+        if not files:
+            raise FileNotFoundError(
+                "no plugins/profile/*/*.trace.json.gz under %s" % path)
+        return max(files, key=os.path.getmtime)
+    return path
+
+
+def device_pids(events):
+    """pids whose process_name marks a device plane — keep in sync with
+    mxnet_tpu.profiler.device_op_events."""
+    pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = e.get("args", {}).get("name", "")
+            if "/device:" in pname.lower() or pname.startswith("TPU") or \
+                    "accelerator" in pname.lower():
+                pids.add(e["pid"])
+    return pids
+
+
+# --------------------------------------------------------------- merging
+def _plane_min_ts(events):
+    ts = [e["ts"] for e in events
+          if e.get("ph") == "X" and isinstance(e.get("ts"), (int, float))]
+    return min(ts) if ts else 0.0
+
+
+def merge_traces(host_events, dev_events, align="zero"):
+    """Return (merged_event_list, stats dict)."""
+    dpids = device_pids(dev_events)
+    dev_kept = [e for e in dev_events if e.get("pid") in dpids]
+
+    host_shift = 0.0
+    dev_shift = 0.0
+    if align == "zero":
+        host_shift = -_plane_min_ts(host_events)
+        dev_shift = -_plane_min_ts(dev_kept)
+    elif align == "epoch":
+        dev_shift = _plane_min_ts(host_events) - _plane_min_ts(dev_kept)
+
+    merged = [{"ph": "M", "name": "process_name", "pid": HOST_PID,
+               "args": {"name": "mxnet_tpu host"}},
+              {"ph": "M", "name": "process_sort_index", "pid": HOST_PID,
+               "args": {"sort_index": 0}}]
+    host_x = 0
+    for e in host_events:
+        e = dict(e)
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            continue  # replaced by the plane header above
+        e["pid"] = HOST_PID
+        if e.get("ph") == "X":
+            e["ts"] = e.get("ts", 0) + host_shift
+            host_x += 1
+        merged.append(e)
+
+    pid_map = {}
+    dev_x = 0
+    for e in dev_kept:
+        e = dict(e)
+        new_pid = pid_map.setdefault(e["pid"],
+                                     DEVICE_PID_BASE + len(pid_map))
+        e["pid"] = new_pid
+        if e.get("ph") == "X":
+            e["ts"] = e.get("ts", 0) + dev_shift
+            dev_x += 1
+        merged.append(e)
+
+    return merged, {"host_events": host_x, "device_events": dev_x,
+                    "device_planes": len(pid_map),
+                    "host_shift_us": host_shift, "device_shift_us": dev_shift}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge an MXNET_TPU_TRACE host trace with a "
+                    "jax.profiler device trace into one Chrome trace.")
+    ap.add_argument("host", help="host span trace (MXNET_TPU_TRACE output)")
+    ap.add_argument("device",
+                    help="device trace file (*.trace.json[.gz]) or "
+                         "jax.profiler trace directory")
+    ap.add_argument("-o", "--out", default="merged.trace.json",
+                    help="output path (default: merged.trace.json)")
+    ap.add_argument("--align", choices=("zero", "epoch", "none"),
+                    default="zero",
+                    help="zero: both planes start at t=0 (default); "
+                         "epoch: shift device onto host wall-clock; "
+                         "none: raw timestamps")
+    args = ap.parse_args(argv)
+
+    host_events = load_chrome_trace(args.host)
+    dev_events = load_chrome_trace(resolve_device_trace(args.device))
+    merged, stats = merge_traces(host_events, dev_events, align=args.align)
+
+    with open(args.out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+
+    stats["out"] = args.out
+    print(json.dumps(stats))
+    if stats["device_events"] == 0:
+        print("warning: no device-plane events found (CPU backend exports "
+              "host tracing only)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
