@@ -48,6 +48,7 @@ _LAZY = {
     "profiler": ".profiler",
     "telemetry": ".telemetry",
     "tracing": ".tracing",
+    "resilience": ".resilience",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "parallel": ".parallel",

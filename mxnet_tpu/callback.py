@@ -54,7 +54,10 @@ class Speedometer:
 
 def do_checkpoint(prefix, period=1):
     """Epoch-end callback saving `prefix-symbol.json`/`prefix-NNNN.params`
-    (reference callback.py do_checkpoint)."""
+    (reference callback.py do_checkpoint).  Saves publish atomically with
+    retry via mx.resilience, so a crash mid-save never corrupts the last
+    good checkpoint; with MXNET_TPU_ON_PREEMPT=save_and_exit, Module.fit
+    runs this callback before the preemption exit."""
     from .model import save_checkpoint
     period = int(max(1, period))
 

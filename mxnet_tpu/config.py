@@ -257,9 +257,100 @@ def _apply_tracing_ring(value):
     tracing.configure_ring(value)
 
 
+def _apply_tracing_watchdog_dir(_value):
+    # the dir must land even when only it changes — an on-demand
+    # dump_watchdog_report (e.g. the nanguard abort) reads it without the
+    # watchdog deadline ever being armed
+    _apply_tracing_watchdog(get("tracing.watchdog"))
+
+
 _ON_SET["tracing.sink"] = _apply_tracing_sink
 _ON_SET["tracing.watchdog"] = _apply_tracing_watchdog
+_ON_SET["tracing.watchdog_dir"] = _apply_tracing_watchdog_dir
 _ON_SET["tracing.ring_size"] = _apply_tracing_ring
+
+# fault tolerance (docs/RESILIENCE.md)
+register_knob(
+    "resilience.nanguard", "MXNET_TPU_NANGUARD", str, "",
+    "non-finite step guard folded into the fused train steps: 'skip' "
+    "drops the optimizer update on steps whose loss/grads go NaN/Inf "
+    "(params keep their last-good values, <source>.nonfinite_steps "
+    "counts them) and aborts-with-checkpoint after nanguard_patience "
+    "consecutive bad steps; 'abort' aborts on the first bad step. The "
+    "all-finite check runs on device — no host sync on the happy path. "
+    "Empty (default) disables.")
+register_knob(
+    "resilience.nanguard_patience", "MXNET_TPU_NANGUARD_PATIENCE", int, 25,
+    "consecutive non-finite steps tolerated under nanguard=skip before "
+    "the watchdog flight recorder dumps and the run aborts with a "
+    "checkpoint (abort mode always uses 1).")
+register_knob(
+    "resilience.on_preempt", "MXNET_TPU_ON_PREEMPT", str, "",
+    "'save_and_exit' installs SIGTERM/SIGINT handlers: the training "
+    "loops finish the in-flight step, checkpoint, flush telemetry/trace "
+    "sinks and exit 0 (a second signal kills immediately). Empty "
+    "(default) leaves signals untouched.")
+register_knob(
+    "resilience.faults", "MXNET_TPU_FAULTS", str, "",
+    "deterministic fault-injection spec, e.g. "
+    "'io:0.05,ckpt_write:1@step=3,nan:1@step=7' — kind:probability per "
+    "opportunity, or kind:count@step=N (1-based). Kinds: io (batch "
+    "fetch), kvstore (push/pull), ckpt_write (inside atomic_write), nan "
+    "(poison a training batch). Empty (default) disables the harness.")
+register_knob(
+    "resilience.fault_seed", "MXNET_TPU_FAULT_SEED", int, 0,
+    "seed for the fault-injection RNGs and retry jitter; two runs with "
+    "the same spec+seed inject identical faults.")
+register_knob(
+    "resilience.retry_attempts", "MXNET_TPU_RETRY_ATTEMPTS", int, 3,
+    "total attempts for retryable I/O (io batch fetch, kvstore "
+    "push/pull, checkpoint writes) on OSError; retries bump "
+    "resilience.retries[.<kind>].")
+register_knob(
+    "resilience.retry_base_s", "MXNET_TPU_RETRY_BASE_S", float, 0.05,
+    "first retry backoff in seconds; doubles per attempt with seeded "
+    "jitter, capped at 2s.")
+register_knob(
+    "resilience.ckpt_every_n_steps", "MXNET_TPU_CKPT_EVERY", int, 0,
+    "CheckpointManager default cadence: maybe_save() writes every N "
+    "steps (0 = only explicit save() calls).")
+register_knob(
+    "resilience.ckpt_keep", "MXNET_TPU_CKPT_KEEP", int, 3,
+    "CheckpointManager retention: keep the newest K checkpoints, prune "
+    "older ones (<=0 keeps everything).")
+
+
+def _apply_resilience_nanguard(value):
+    v = (value or "").strip()
+    if v not in ("", "skip", "abort"):
+        # reject at set() time and revert, so a typo can't silently leave
+        # training unguarded (or half-guarded) until the next step
+        _OVERRIDES.pop("resilience.nanguard", None)
+        raise ValueError("resilience.nanguard must be '', 'skip' or "
+                         "'abort', got %r" % (value,))
+
+
+def _apply_resilience_faults(_value):
+    from . import resilience
+    resilience.configure_faults()
+
+
+def _apply_resilience_preempt(value):
+    from . import resilience
+    resilience.configure_preemption(value)
+
+
+def _apply_resilience_retry(_value):
+    from . import resilience
+    resilience.configure_retry()
+
+
+_ON_SET["resilience.nanguard"] = _apply_resilience_nanguard
+_ON_SET["resilience.faults"] = _apply_resilience_faults
+_ON_SET["resilience.fault_seed"] = _apply_resilience_faults
+_ON_SET["resilience.on_preempt"] = _apply_resilience_preempt
+_ON_SET["resilience.retry_attempts"] = _apply_resilience_retry
+_ON_SET["resilience.retry_base_s"] = _apply_resilience_retry
 
 # kvstore / gradient sync
 register_knob(

@@ -49,7 +49,15 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = []
+        # optional checkpoint hook for preemption / nanguard-abort saves
+        # (set via set_preemption_save)
+        self._preempt_save = None
         self._reset_kvstore()
+
+    def set_preemption_save(self, fn):
+        """Register a zero-arg callable run before a preemption exit or a
+        nanguard abort (e.g. ``lambda: net.save_parameters(path)``)."""
+        self._preempt_save = fn
 
     def _check_contexts(self):
         contexts = None
@@ -147,6 +155,8 @@ class Trainer:
         ``gluon.opt_update`` children (docs/OBSERVABILITY.md)."""
         from .. import telemetry as _telemetry
         from .. import tracing as _tracing
+        from .. import resilience as _resilience
+        _resilience.maybe_abort_nonfinite("gluon", save_fn=self._preempt_save)
         with _telemetry.step_scope("gluon", samples=int(batch_size),
                                    default_path="eager"), \
                 _tracing.span("gluon.step", cat="gluon"):
@@ -160,6 +170,8 @@ class Trainer:
                 self._allreduce_grads()
             with _tracing.span("gluon.opt_update", cat="gluon"):
                 self._update(ignore_stale_grad)
+        if _resilience.preempt_requested():
+            _resilience.exit_on_preempt(save_fn=self._preempt_save)
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kv_initialized and self._kvstore:
@@ -222,6 +234,23 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._kvstore and self._update_on_kvstore:
             return
+        from .. import resilience as _resilience
+        if _resilience.nanguard_mode():
+            # autograd-eager path: one host sync per step is the cost of
+            # running unfused (the fused paths check on-device)
+            import numpy as _np
+            finite = True
+            for param in self._params:
+                if param.grad_req == "null":
+                    continue
+                g = param.grad()
+                if not _np.all(_np.isfinite(g.asnumpy())):
+                    finite = False
+                    break
+            if not finite:
+                _resilience.report_nonfinite("gluon")
+                return
+            _resilience.note_finite("gluon")
         updater = self._updaters[0]
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -252,7 +281,8 @@ class Trainer:
                 "yet initialized in kvstore."
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
+            from .. import resilience as _resilience
+            with _resilience.atomic_write(fname, "wb") as fout:
                 fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):

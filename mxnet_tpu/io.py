@@ -22,6 +22,7 @@ from collections import namedtuple
 import numpy as _np
 
 from . import telemetry as _telemetry
+from . import resilience as _resilience
 from .ndarray.ndarray import NDArray, _wrap
 import jax.numpy as jnp
 
@@ -77,9 +78,12 @@ class DataIter:
 
     def __next__(self):
         # batch-fetch latency for every iterator on the pipeline boundary:
-        # a slow p99 here means the chip starves waiting on host data
+        # a slow p99 here means the chip starves waiting on host data.
+        # Transient I/O errors (network filesystems, object stores) retry
+        # with backoff; StopIteration passes straight through.
         t0 = _time.perf_counter()
-        batch = self.next()
+        batch = _resilience.call_with_retry(self.next, kind="io",
+                                            inject_faults=True)
         _telemetry.timer("io.batch_fetch").observe(
             _time.perf_counter() - t0)
         return batch
@@ -345,7 +349,10 @@ class PrefetchingIter(DataIter):
             while not self._stop.is_set():
                 try:
                     with _tracing.span("io.prefetch", cat="io"):
-                        batches = [it.next() for it in self.iters]
+                        batches = [
+                            _resilience.call_with_retry(
+                                it.next, kind="io", inject_faults=True)
+                            for it in self.iters]
                 except StopIteration:
                     self._queue.put(None)
                     return

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from .ndarray.ndarray import NDArray, _wrap
 from . import optimizer as opt
 from . import telemetry as _telemetry
+from . import resilience as _resilience
 
 __all__ = ["KVStore", "create"]
 
@@ -153,7 +154,13 @@ class KVStore:
         _telemetry.counter("kvstore.push_calls").inc()
         _telemetry.counter("kvstore.push_bytes").inc(_payload_bytes(values))
         with _tracing.span("kvstore.push", cat="kvstore", keys=len(keys)):
-            self._push_impl(keys, values)
+            # transient transport errors retry with backoff; fault
+            # injection ("kvstore" kind) fires at entry, before any key is
+            # merged, so a retried injected fault never double-applies an
+            # update.  Real mid-body failures on the update_on_kvstore
+            # path may re-run the updater for already-pushed keys.
+            _resilience.call_with_retry(self._push_impl, keys, values,
+                                        kind="kvstore", inject_faults=True)
 
     def _push_impl(self, keys, values):
         for k, v in zip(keys, values):
@@ -180,11 +187,17 @@ class KVStore:
         _telemetry.counter("kvstore.pull_calls").inc()
         _telemetry.counter("kvstore.pull_bytes").inc(_payload_bytes(outs))
         with _tracing.span("kvstore.pull", cat="kvstore", keys=len(keys)):
-            for k, o in zip(keys, outs):
-                src = self._store[k]
-                targets = o if isinstance(o, (list, tuple)) else [o]
-                for t in targets:
-                    t._data = jnp.asarray(src._data, t._data.dtype)
+            # pull is idempotent (pure store → out copy), so retrying a
+            # mid-body failure is always safe
+            _resilience.call_with_retry(self._pull_impl, keys, outs,
+                                        kind="kvstore", inject_faults=True)
+
+    def _pull_impl(self, keys, outs):
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.asarray(src._data, t._data.dtype)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Combined push and pull (reference: kvstore.py:290)."""
@@ -256,7 +269,7 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater_obj is not None, "Cannot save states for distributed training"
-        with open(fname, "wb") as fout:
+        with _resilience.atomic_write(fname, "wb") as fout:
             fout.write(self._updater_obj.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

@@ -40,11 +40,18 @@ def unpack_params(loaded):
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
+    """Checkpoint symbol + params.  Both files publish atomically (tmp +
+    fsync + rename via mx.resilience), and transient I/O errors retry
+    with backoff, so a preempted or crashing save never clobbers the
+    previous epoch's checkpoint."""
     from .ndarray.ndarray import save as nd_save
+    from . import resilience as _resilience
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
-    nd_save("%s-%04d.params" % (prefix, epoch),
-            pack_params(arg_params, aux_params))
+        _resilience.call_with_retry(symbol.save, "%s-symbol.json" % prefix,
+                                    kind="ckpt_write")
+    _resilience.call_with_retry(nd_save, "%s-%04d.params" % (prefix, epoch),
+                                pack_params(arg_params, aux_params),
+                                kind="ckpt_write")
 
 
 def load_params(prefix, epoch):

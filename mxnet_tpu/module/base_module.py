@@ -75,6 +75,11 @@ class BaseModule:
         spans hang off (docs/OBSERVABILITY.md)."""
         from .. import telemetry as _telemetry
         from .. import tracing as _tracing
+        from .. import resilience as _resilience
+        # nanguard=abort: the device notification lands asynchronously, so
+        # the abort fires at the start of a later step (dict lookup when
+        # the guard never tripped — no per-step cost)
+        _resilience.maybe_abort_nonfinite("module")
         with _telemetry.step_scope("module", batch=data_batch), \
                 _tracing.span("module.step", cat="module"):
             self.forward_backward(data_batch)
@@ -130,6 +135,16 @@ class BaseModule:
                     for cb in _as_list(batch_end_callback):
                         cb(params)
                 nbatch += 1
+                from .. import resilience as _resilience
+                if _resilience.preempt_requested():
+                    # finish the in-flight step (done above), checkpoint
+                    # via the user's epoch-end callbacks, flush sinks, and
+                    # exit 0 (MXNET_TPU_ON_PREEMPT=save_and_exit)
+                    if epoch_end_callback is not None:
+                        arg_params, aux_params = self.get_params()
+                        for cb in _as_list(epoch_end_callback):
+                            cb(epoch, self.symbol, arg_params, aux_params)
+                    _resilience.exit_on_preempt(logger=self.logger)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

@@ -266,6 +266,7 @@ class Module(BaseModule):
         (Executor.fused_step_fn).  Mirrors SPMDTrainer.step for the
         symbolic path."""
         from .. import random as _random
+        from .. import resilience as _resilience
         from ..parallel.trainer import (_opt_hyper_arrays, _state_to_jax)
         from .. import profiler as _profiler
         import jax
@@ -319,9 +320,20 @@ class Module(BaseModule):
         rest_env = {n: v for n, v in exec_._env().items()
                     if n not in opt_state and n not in feeds}
         key = _random.new_eager_seed_key()
-        new_w, new_s, aux_updates, outs = fn(
-            wrt_vals, opt_state, rest_env, feeds, key,
-            jnp.asarray(t, jnp.int32), lrs, wds)
+        guard = _resilience.nanguard_mode()
+        if guard:
+            streak = shared.get("nan_streak")
+            if streak is None:
+                streak = jnp.zeros((), jnp.int32)
+            new_w, new_s, aux_updates, outs, shared["nan_streak"] = fn(
+                wrt_vals, opt_state, rest_env, feeds, key,
+                jnp.asarray(t, jnp.int32), lrs, wds, streak)
+            # no-sync host inspection of completed steps' streaks
+            _resilience.watch_streak("module", shared["nan_streak"])
+        else:
+            new_w, new_s, aux_updates, outs = fn(
+                wrt_vals, opt_state, rest_env, feeds, key,
+                jnp.asarray(t, jnp.int32), lrs, wds)
         for n in wrt:
             exec_.arg_dict[n]._data = new_w[n]
             state[n] = new_s[n]
@@ -376,6 +388,18 @@ class Module(BaseModule):
             return
         from .. import profiler as _profiler
         _profiler.counter_increment("eager_steps")
+        from .. import resilience as _resilience
+        if _resilience.nanguard_mode():
+            # eager path has no fused program to fold the check into; one
+            # host sync per step is the cost of running unfused
+            import numpy as _np
+            finite = all(
+                bool(_np.all(_np.isfinite(_np.asarray(g._data))))
+                for g in self._exec.grad_dict.values() if g is not None)
+            if not finite:
+                _resilience.report_nonfinite("module")
+                return
+            _resilience.note_finite("module")
         with _tracing.span("module.opt_update", cat="module"):
             for i, name in enumerate(self._param_names):
                 g = self._exec.grad_dict.get(name)

@@ -645,7 +645,11 @@ def waitall():
 # --------------------------------------------------------------------------
 
 def save(fname, data):
-    """Save NDArray / list / dict of NDArrays (.npz container)."""
+    """Save NDArray / list / dict of NDArrays (.npz container).
+
+    The file is published atomically (tmp + fsync + rename), so a crash
+    mid-save can never leave a truncated file at ``fname`` — readers see
+    either the previous complete file or the new one."""
     if isinstance(data, NDArray):
         payload, names = [data], ["__mx_single__"]
     elif isinstance(data, (list, tuple)):
@@ -657,7 +661,9 @@ def save(fname, data):
     else:
         raise TypeError("save expects NDArray, list or dict")
     arrays = {n: p.asnumpy() for n, p in zip(names, payload)}
-    with open(fname, "wb") as f:  # exact filename, no .npz suffix magic
+    from .. import resilience as _resilience
+    # exact filename, no .npz suffix magic (savez gets a handle, not a name)
+    with _resilience.atomic_write(fname, "wb") as f:
         _np.savez(f, **arrays)
 
 

@@ -125,6 +125,13 @@ class SPMDTrainer:
         self._step_num = 0
         self._jitted = None
         self._donate = donate
+        # resilience (docs/RESILIENCE.md): optional CheckpointManager for
+        # periodic save / preemption save / auto-resume, plus the nanguard
+        # bad-step streak carried as a device scalar so the fused step
+        # never syncs the host on finite steps
+        self._ckpt_manager = None
+        self._guard_mode = ""
+        self._nan_streak = None
         # channels-last weights end-to-end (conv.weights_layout=HWIO,
         # docs/PERF_NOTES.md): conv weights + grads + optimizer state live
         # HWIO inside the trainer; boundaries (sync, single-file
@@ -263,8 +270,10 @@ class SPMDTrainer:
             loss = _as_scalar_loss(loss_fn, out, label)
             return loss, (new_aux, out)
 
+        guard = self._guard_mode
+
         def step(train_params, aux_params, opt_state, data, label, key, t,
-                 lrs, wds, lr_scale):
+                 lrs, wds, lr_scale, streak=None):
             (loss, (new_aux, _)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_params, aux_params, data, label,
                                        key)
@@ -284,7 +293,21 @@ class SPMDTrainer:
                     new_state[n] = s
             aux_out = dict(aux_params)
             aux_out.update(new_aux)
-            return new_params, aux_out, new_state, loss
+            if not guard:
+                return new_params, aux_out, new_state, loss
+            # nanguard (docs/RESILIENCE.md): all on-device — a bad step
+            # keeps the pre-step params/state/aux (the update is computed
+            # then deselected; XLA still fuses it into one program) and the
+            # host hears about it only through the cond-gated callback, so
+            # finite steps pay zero host sync
+            from .. import resilience as _resilience
+            finite = _resilience.all_finite(loss, grads)
+            new_streak = _resilience.guarded_streak(finite, streak, "spmd")
+            new_params = _resilience.select_tree(finite, new_params,
+                                                 train_params)
+            new_state = _resilience.select_tree(finite, new_state, opt_state)
+            aux_out = _resilience.select_tree(finite, aux_out, aux_params)
+            return new_params, aux_out, new_state, loss, new_streak
 
         # Sharding is carried by the arguments themselves (params were
         # device_put with their NamedShardings in _place(); the batch is
@@ -306,12 +329,21 @@ class SPMDTrainer:
         (docs/OBSERVABILITY.md).  Wall time is host-side dispatch time —
         async device work overlaps the next step by design."""
         from ..ndarray.ndarray import NDArray
+        from .. import resilience as _resilience
         from .. import telemetry as _telemetry
         from .. import tracing as _tracing
         if isinstance(data, NDArray):
             data = data._data
         if isinstance(label, NDArray):
             label = label._data
+        # nanguard escalation check: a dict lookup per step; raises
+        # NonFiniteStepError (after flight-recorder dump + checkpoint)
+        # once the device reported K consecutive bad steps
+        _resilience.maybe_abort_nonfinite("spmd",
+                                          save_fn=self._preempt_save)
+        if _resilience.faults_active("nan") and _resilience.should_inject(
+                "nan", step=self._step_num + 1):
+            data = _resilience.poison_batch(data)
         with _telemetry.step_scope(
                 "spmd", samples=int(data.shape[0]) if
                 getattr(data, "ndim", 0) else None,
@@ -320,13 +352,26 @@ class SPMDTrainer:
                                                 self.mesh.devices.shape)},
                 default_path="fused"), \
                 _tracing.span("spmd.step", cat="spmd"):
-            return self._step_impl(data, label, lr_scale)
+            loss = self._step_impl(data, label, lr_scale)
+        if self._ckpt_manager is not None:
+            self._ckpt_manager.maybe_save(self._step_num,
+                                          self.save_checkpoint)
+        if _resilience.preempt_requested():
+            # the in-flight step is done (save gathers to host, which
+            # syncs); checkpoint, flush sinks, exit 0
+            _resilience.exit_on_preempt(save_fn=self._preempt_save)
+        return loss
 
     def _step_impl(self, data, label, lr_scale):
+        from .. import resilience as _resilience
         from .. import tracing as _tracing
         if self.params is None:
             self._materialize(data)
+        guard = _resilience.nanguard_mode()
+        if self._jitted is not None and guard != self._guard_mode:
+            self._jitted = None  # knob flip: rebuild with/without the guard
         if self._jitted is None:
+            self._guard_mode = guard
             with _tracing.span("spmd.compile", cat="spmd"):
                 self._jitted = self._build()
             from .. import profiler as _profiler
@@ -356,9 +401,19 @@ class SPMDTrainer:
             sarr = jnp.asarray(lr_scale, jnp.float32)
             if cacheable and len(scales) < 16:
                 scales[lr_scale] = sarr
-        new_train, new_aux, self.opt_state, loss = self._jitted(
-            train, aux, self.opt_state, data, label, key,
-            jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
+        if self._guard_mode:
+            if self._nan_streak is None:
+                self._nan_streak = jnp.zeros((), jnp.int32)
+            new_train, new_aux, self.opt_state, loss, self._nan_streak = \
+                self._jitted(train, aux, self.opt_state, data, label, key,
+                             jnp.asarray(self._step_num, jnp.int32), lrs,
+                             wds, sarr, self._nan_streak)
+            # no-sync host inspection of completed steps' streaks
+            _resilience.watch_streak("spmd", self._nan_streak)
+        else:
+            new_train, new_aux, self.opt_state, loss = self._jitted(
+                train, aux, self.opt_state, data, label, key,
+                jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
         from .. import profiler as _profiler
         _profiler.counter_increment("fused_steps")
         self.params = {}
@@ -372,6 +427,24 @@ class SPMDTrainer:
         self.fn.write_back(self._layout_ref(self.params))
 
     # ---------------------------------------------------------- checkpoint
+    def attach_checkpoint_manager(self, manager, auto_resume=True):
+        """Wire a ``resilience.CheckpointManager`` into the step loop:
+        ``maybe_save`` fires on its every-N cadence after each step, a
+        preemption signal checkpoints through it before exiting, and the
+        nanguard abort path writes a last-good checkpoint.  With
+        ``auto_resume`` (default) the newest GOOD checkpoint is restored
+        immediately — a corrupt/truncated newest file is skipped for the
+        last good one.  Returns the resumed step, or None on cold start."""
+        self._ckpt_manager = manager
+        if auto_resume:
+            return manager.restore(self.load_checkpoint)
+        return None
+
+    def _preempt_save(self):
+        """Best-effort checkpoint for preemption/nanguard-abort exits."""
+        if self._ckpt_manager is not None and self.params is not None:
+            self._ckpt_manager.save(self._step_num, self.save_checkpoint)
+
     def _ckpt_meta(self):
         """Shared guard + metadata for both checkpoint formats."""
         from .. import random as _random
@@ -419,8 +492,29 @@ class SPMDTrainer:
         from .. import random as _random
 
         path = os.path.abspath(path)
+        from .. import resilience as _resilience
+        if not os.path.isdir(path) or not os.path.exists(
+                os.path.join(path, "_CHECKPOINT_METADATA")):
+            raise _resilience.CheckpointCorruptError(
+                "%s is not an orbax checkpoint (missing "
+                "_CHECKPOINT_METADATA — interrupted save or wrong path)"
+                % path)
         ckptr = ocp.StandardCheckpointer()
-        md = ckptr.metadata(path).item_metadata.tree
+        try:
+            md = ckptr.metadata(path)
+            if hasattr(md, "item_metadata"):
+                # newer orbax wraps the tree in a StepMetadata-style object;
+                # 0.7.x StandardCheckpointer returns the tree dict directly
+                md = md.item_metadata.tree
+        except Exception as exc:  # noqa: BLE001 — orbax raises many types
+            raise _resilience.CheckpointCorruptError(
+                "orbax metadata for %s is unreadable (%s: %s)"
+                % (path, type(exc).__name__, exc)) from exc
+        if not isinstance(md, dict) or not {
+                "params", "opt_state", "meta"} <= set(md):
+            raise _resilience.CheckpointCorruptError(
+                "orbax checkpoint %s carries no usable tree metadata "
+                "(got %s)" % (path, type(md).__name__))
         mesh = self.mesh
 
         def abstract(meta, spec):
@@ -462,12 +556,15 @@ class SPMDTrainer:
         """
         import numpy as np
         import pickle
+        from .. import resilience as _resilience
         step_num, rng_key = self._ckpt_meta()
         # single-file checkpoints always carry the reference OIHW layout so
         # they stay interchangeable across conv.weights_layout settings
         ref_params = self._layout_ref(self.params)
         ref_state = self._layout_state(self.opt_state, to_internal=False)
         host = {
+            "schema": _resilience.CKPT_SCHEMA,
+            "format": "mxnet_tpu-spmd-ckpt",
             "step_num": step_num,
             "params": {n: _to_host(v) for n, v in ref_params.items()},
             "opt_state": jax.tree_util.tree_map(_to_host, ref_state),
@@ -476,16 +573,40 @@ class SPMDTrainer:
             # bitwise-continue guarantee to hold.
             "rng_key": np.asarray(rng_key),
         }
-        with open(path, "wb") as f:
+        # atomic publish: a crash mid-write leaves the previous checkpoint
+        # under `path`, never a truncated pickle (docs/RESILIENCE.md)
+        with _resilience.atomic_write(path, "wb") as f:
             pickle.dump(host, f)
 
     def load_checkpoint(self, path):
         """Restore a `save_checkpoint` file; training continues bitwise
-        where it left off (same data ⇒ same loss curve)."""
+        where it left off (same data ⇒ same loss curve).
+
+        Truncated/unpicklable files and newer-schema checkpoints raise
+        ``resilience.CheckpointCorruptError`` up front — never a deep
+        ``EOFError``/``KeyError`` from half-restored state — so
+        ``CheckpointManager.restore`` can fall back to the previous one."""
         import pickle
         from .. import random as _random
-        with open(path, "rb") as f:
-            host = pickle.load(f)
+        from .. import resilience as _resilience
+        try:
+            with open(path, "rb") as f:
+                host = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
+                ImportError, IndexError, ValueError) as exc:
+            raise _resilience.CheckpointCorruptError(
+                "checkpoint %s is unreadable (%s: %s)"
+                % (path, type(exc).__name__, exc)) from exc
+        if not isinstance(host, dict) or not (
+                {"step_num", "params", "opt_state"} <= set(host)):
+            raise _resilience.CheckpointCorruptError(
+                "checkpoint %s is not an SPMDTrainer checkpoint (missing "
+                "step_num/params/opt_state)" % path)
+        if int(host.get("schema", 1)) > _resilience.CKPT_SCHEMA:
+            raise _resilience.CheckpointCorruptError(
+                "checkpoint %s was written by a newer schema (%s > %s); "
+                "upgrade this framework to load it"
+                % (path, host.get("schema"), _resilience.CKPT_SCHEMA))
         self._step_num = host["step_num"]
         self.optimizer.num_update = self._step_num
         self.params = self._layout_internal(
@@ -495,6 +616,8 @@ class SPMDTrainer:
         self._place()
         if "rng_key" in host:
             _random._STATE.key = jnp.asarray(host["rng_key"])
+        self._nan_streak = None  # restored params are finite by definition
+        return self._step_num
 
 
 def _to_host(x):

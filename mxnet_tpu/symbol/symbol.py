@@ -367,7 +367,8 @@ class Symbol:
                            "format": "mxnet_tpu-symbol-v1"}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from .. import resilience as _resilience
+        with _resilience.atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
 
@@ -1058,14 +1059,19 @@ class Executor:
         the traced update count for bias-corrected optimizers (Adam &c).
         """
         from .. import config as _config
+        from .. import resilience as _resilience
         sym = self._symbol
         wrt_t = tuple(wrt)
         rescale = float(optimizer.rescale_grad)
         clip = optimizer.clip_gradient
+        # nanguard bakes into the trace: when armed the program takes a
+        # consecutive-bad-step streak carry and returns it (5-tuple); the
+        # happy-path signature is untouched when the knob is off
+        guard = _resilience.nanguard_mode()
         # the program closes over the optimizer, so its identity (and the
         # scalars baked in at trace time) is part of the key; cached entries
         # keep their optimizer alive, so id() stays unambiguous
-        key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig,
+        key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig, guard,
                    _config.epoch())
         fn = self._fused_cache.get(key_sig)
         if fn is not None:
@@ -1075,7 +1081,8 @@ class Executor:
         self._fused_cache = {k: v for k, v in self._fused_cache.items()
                              if k[-1] == key_sig[-1]}
 
-        def run(wrt_vals, opt_state, rest_env, feeds, key, t, lrs, wds):
+        def run(wrt_vals, opt_state, rest_env, feeds, key, t, lrs, wds,
+                streak=None):
             env = dict(rest_env)
             env.update(feeds)
 
@@ -1102,7 +1109,20 @@ class Executor:
                                           lrs[i], wds[i], t)
                     new_w[n] = w.astype(wrt_vals[n].dtype)
                     new_s[n] = s
-            return new_w, new_s, aux_updates, outs
+            if not guard:
+                return new_w, new_s, aux_updates, outs
+            # non-finite step guard: keep old params/state/aux on a bad
+            # step; the check stays on-device (no host sync unless the
+            # bad branch actually fires)
+            finite = _resilience.all_finite(outs, grads)
+            new_streak = _resilience.guarded_streak(finite, streak,
+                                                    "module")
+            new_w = _resilience.select_tree(finite, new_w, wrt_vals)
+            new_s = _resilience.select_tree(finite, new_s, opt_state)
+            aux_updates = _resilience.select_tree(
+                finite, aux_updates,
+                {n: rest_env[n] for n in aux_updates})
+            return new_w, new_s, aux_updates, outs, new_streak
 
         # donation needs a real accelerator: the CPU backend can't alias
         # donated buffers (it would only warn and copy anyway)

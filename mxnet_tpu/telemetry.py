@@ -296,6 +296,21 @@ def enabled():
     return _SINK is not None
 
 
+def flush():
+    """Force the JSONL sink to disk (fsync) — called by
+    ``resilience.flush_sinks`` on preemption/abort so the log from a dying
+    run ends at the truth, not one buffer short of it."""
+    import os as _os
+    with _SINK_LOCK:
+        if _SINK is None:
+            return
+        _SINK.flush()
+        try:
+            _os.fsync(_SINK.fileno())
+        except OSError:  # pragma: no cover — non-fsyncable sink
+            pass
+
+
 def sink_path():
     return _SINK_PATH
 
@@ -474,3 +489,7 @@ except KeyError:  # pragma: no cover — config stripped of the knob
 # training-path import (io/module/kvstore all import telemetry) activates
 # the tracing env vars too
 from . import tracing as _tracing  # noqa: E402,F401
+
+# mx.resilience likewise honors MXNET_TPU_FAULTS / MXNET_TPU_ON_PREEMPT at
+# its import (it only imports config at module scope, so no cycle)
+from . import resilience as _resilience  # noqa: E402,F401

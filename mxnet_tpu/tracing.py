@@ -158,6 +158,20 @@ def sink_path():
     return _SINK_PATH
 
 
+def flush():
+    """Force buffered span events to disk (fsync) — the sink's streaming
+    line format is truncation-tolerant (load_trace), so a flushed partial
+    trace from a preempted run is fully loadable."""
+    with _SINK_LOCK:
+        if _SINK is None:
+            return
+        _SINK.flush()
+        try:
+            os.fsync(_SINK.fileno())
+        except OSError:  # pragma: no cover — non-fsyncable sink
+            pass
+
+
 # --------------------------------------------------------- flight recorder
 _RING_LOCK = threading.Lock()
 _RING = deque(maxlen=256)

@@ -1,0 +1,491 @@
+"""mx.resilience — atomic checkpoints, manager fallback, preemption,
+nanguard, retry/backoff, and deterministic fault injection.
+
+Covers the resilience PR: the atomic writer's crash-safety contract (a
+failed publish never clobbers the previous file), CRC-manifest integrity
+verification, CheckpointManager retention / corrupt-newest fallback,
+SPMDTrainer checkpoint validation errors, the non-finite step guard in
+skip and abort modes on all three training paths (SPMD fused, Module
+fused, gluon eager) with bitwise skip semantics, SIGTERM preemption with
+bitwise auto-resume, retry counters, the fault-spec parser's determinism,
+and the tools/check_resilience.py chaos smoke as a subprocess.
+"""
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, resilience, telemetry
+from mxnet_tpu.parallel.trainer import SPMDTrainer
+
+
+@pytest.fixture(autouse=True)
+def _resilience_off():
+    """Each test starts with every resilience knob at its default and a
+    zeroed counter registry."""
+    def reset():
+        config.set("resilience.nanguard", "")
+        config.set("resilience.faults", "")
+        config.set("resilience.fault_seed", 0)
+        config.set("resilience.on_preempt", "")
+        config.set("resilience.retry_attempts", 3)
+        config.set("resilience.retry_base_s", 0.001)
+        resilience.reset_nanguard()
+        telemetry.reset()
+    reset()
+    yield
+    reset()
+    config.set("resilience.retry_base_s", 0.05)
+
+
+# --------------------------------------------------------- atomic writer
+def test_atomic_write_publishes_and_cleans_tmp(tmp_path):
+    path = tmp_path / "out.bin"
+    with resilience.atomic_write(str(path), "wb") as f:
+        f.write(b"payload")
+    assert path.read_bytes() == b"payload"
+    assert os.listdir(tmp_path) == ["out.bin"]  # no tmp litter
+
+
+def test_atomic_write_failure_preserves_previous(tmp_path):
+    path = tmp_path / "ckpt.bin"
+    with resilience.atomic_write(str(path), "wb") as f:
+        f.write(b"generation-1")
+    with pytest.raises(RuntimeError):
+        with resilience.atomic_write(str(path), "wb") as f:
+            f.write(b"gener")  # "crash" mid-write
+            raise RuntimeError("power loss")
+    assert path.read_bytes() == b"generation-1"
+    assert os.listdir(tmp_path) == ["ckpt.bin"]
+
+
+def test_manifest_verify_detects_corruption(tmp_path):
+    path = tmp_path / "c.ckpt"
+    with resilience.atomic_write(str(path), "wb") as f:
+        f.write(b"x" * 100)
+    resilience.write_manifest(str(path), step=3)
+    man = json.loads(
+        open(resilience.manifest_path(str(path))).read())
+    assert man["schema"] == resilience.MANIFEST_SCHEMA
+    assert man["step"] == 3
+    resilience.verify_checkpoint(str(path), require_manifest=True)
+    with open(path, "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(resilience.CheckpointCorruptError):
+        resilience.verify_checkpoint(str(path))
+
+
+# ----------------------------------------------------- checkpoint manager
+def _pickle_saver(payload):
+    def saver(path):
+        with resilience.atomic_write(path, "wb") as f:
+            pickle.dump(payload, f)
+    return saver
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = resilience.CheckpointManager(str(tmp_path), every_n_steps=2,
+                                       keep=2)
+    for step in range(1, 9):
+        mgr.maybe_save(step, _pickle_saver({"step": step}))
+    assert [s for s, _ in mgr.checkpoints()] == [6, 8]
+    step, path = mgr.latest()
+    assert step == 8 and os.path.exists(path)
+
+
+def test_manager_restore_falls_back_past_corrupt(tmp_path):
+    mgr = resilience.CheckpointManager(str(tmp_path), keep=5)
+    for step in (2, 4, 6):
+        mgr.save(step, _pickle_saver({"step": step}))
+    with open(mgr.latest()[1], "r+b") as f:
+        f.truncate(5)
+
+    def loader(path):
+        resilience.verify_checkpoint(path)
+        with open(path, "rb") as f:
+            return pickle.load(f)["step"]
+
+    assert mgr.restore(loader) == 4
+    assert telemetry.counter("resilience.ckpt_fallbacks").value == 1
+
+
+def test_manager_save_failure_keeps_previous_loadable(tmp_path):
+    config.set("resilience.retry_attempts", 1)  # no second chance
+    mgr = resilience.CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(1, _pickle_saver({"step": 1}))
+    config.set("resilience.faults", "ckpt_write:1")
+    with pytest.raises(OSError):
+        mgr.save(2, _pickle_saver({"step": 2}))
+    config.set("resilience.faults", "")
+    step, path = resilience.CheckpointManager(str(tmp_path)).latest()
+    assert step == 1
+    resilience.verify_checkpoint(path, require_manifest=True)
+
+
+# --------------------------------------------------------- retry/backoff
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert resilience.call_with_retry(flaky, kind="io") == "ok"
+    assert calls["n"] == 3
+    assert telemetry.counter("resilience.retries").value == 2
+    assert telemetry.counter("resilience.retries.io").value == 2
+
+
+def test_retry_exhaustion_reraises():
+    def broken():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        resilience.call_with_retry(broken, kind="io")
+    assert telemetry.counter("resilience.retries").value == 2  # 3 attempts
+
+
+def test_retry_passes_stopiteration_through():
+    def done():
+        raise StopIteration
+
+    with pytest.raises(StopIteration):
+        resilience.call_with_retry(done, kind="io")
+    assert telemetry.counter("resilience.retries").value == 0
+
+
+# -------------------------------------------------------- fault injection
+def test_fault_spec_parser():
+    by_kind = resilience.parse_faults("io:0.05,ckpt_write:1@step=3,nan:0.5")
+    assert by_kind["io"].prob == pytest.approx(0.05)
+    assert by_kind["ckpt_write"].count == 1
+    assert by_kind["ckpt_write"].at_step == 3
+    assert by_kind["nan"].prob == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        resilience.parse_faults("io")  # no rule
+    with pytest.raises(ValueError):
+        resilience.parse_faults("io:abc")  # not a probability
+    with pytest.raises(ValueError):
+        resilience.parse_faults("io:2")  # count needs @step=N
+
+
+def test_probabilistic_faults_deterministic_across_reconfigure():
+    config.set("resilience.fault_seed", 123)
+    config.set("resilience.faults", "io:0.5")
+    draws1 = [resilience.should_inject("io") for _ in range(50)]
+    config.set("resilience.faults", "io:0.5")  # reset + same seed
+    draws2 = [resilience.should_inject("io") for _ in range(50)]
+    assert draws1 == draws2
+    assert any(draws1) and not all(draws1)
+
+
+def test_at_step_fault_uses_caller_step():
+    config.set("resilience.faults", "nan:2@step=7")
+    # global-step addressing: a resumed run re-injects at the same
+    # TRAINING step regardless of how many calls happened before;
+    # N@step=M means a window of N consecutive steps starting at M
+    assert not resilience.should_inject("nan", step=6)
+    assert resilience.should_inject("nan", step=7)
+    assert resilience.should_inject("nan", step=8)
+    assert not resilience.should_inject("nan", step=9)
+
+
+def test_poison_batch():
+    out = resilience.poison_batch(np.ones((2, 2), np.float32))
+    assert np.isnan(out).all()
+    ints = resilience.poison_batch(np.ones((2,), np.int32))
+    assert ints.dtype == np.int32  # non-float passes through
+
+
+# ---------------------------------------------- SPMD checkpoint validation
+def _make_spmd(prefix):
+    from mxnet_tpu.gluon import nn
+    import mxnet_tpu.gluon.loss as gloss
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=6, prefix=prefix)
+    net.initialize()
+    return SPMDTrainer(net, gloss.L2Loss(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+
+
+def _spmd_batches(n=8):
+    rng = np.random.RandomState(1)
+    return [(rng.randn(8, 6).astype("f4"), rng.randn(8, 4).astype("f4"))
+            for _ in range(n)]
+
+
+def test_spmd_load_checkpoint_truncated_raises(tmp_path):
+    tr = _make_spmd("v0_")
+    tr.step(*_spmd_batches(1)[0])
+    path = str(tmp_path / "c.ckpt")
+    tr.save_checkpoint(path)
+    with open(path, "r+b") as f:
+        f.truncate(20)
+    with pytest.raises(resilience.CheckpointCorruptError):
+        _make_spmd("v1_").load_checkpoint(path)
+
+
+def test_spmd_load_checkpoint_not_a_checkpoint_raises(tmp_path):
+    path = tmp_path / "junk.ckpt"
+    path.write_bytes(pickle.dumps({"not": "a checkpoint"}))
+    with pytest.raises(resilience.CheckpointCorruptError,
+                       match="not an SPMDTrainer checkpoint"):
+        _make_spmd("v2_").load_checkpoint(str(path))
+
+
+def test_spmd_load_checkpoint_future_schema_raises(tmp_path):
+    tr = _make_spmd("v3_")
+    tr.step(*_spmd_batches(1)[0])
+    path = str(tmp_path / "c.ckpt")
+    tr.save_checkpoint(path)
+    with open(path, "rb") as f:
+        host = pickle.load(f)
+    host["schema"] = resilience.CKPT_SCHEMA + 1
+    path2 = str(tmp_path / "future.ckpt")
+    with open(path2, "wb") as f:
+        pickle.dump(host, f)
+    with pytest.raises(resilience.CheckpointCorruptError, match="schema"):
+        _make_spmd("v4_").load_checkpoint(path2)
+
+
+def test_spmd_sharded_load_missing_metadata_raises(tmp_path):
+    d = tmp_path / "not_orbax"
+    d.mkdir()
+    with pytest.raises(resilience.CheckpointCorruptError):
+        _make_spmd("v5_").load_checkpoint_sharded(str(d))
+    with pytest.raises(resilience.CheckpointCorruptError):
+        _make_spmd("v6_").load_checkpoint_sharded(str(tmp_path / "absent"))
+
+
+def test_spmd_save_checkpoint_is_atomic_and_stamped(tmp_path):
+    tr = _make_spmd("v7_")
+    tr.step(*_spmd_batches(1)[0])
+    path = str(tmp_path / "c.ckpt")
+    tr.save_checkpoint(path)
+    with open(path, "rb") as f:
+        host = pickle.load(f)
+    assert host["schema"] == resilience.CKPT_SCHEMA
+    assert host["format"] == "mxnet_tpu-spmd-ckpt"
+    assert os.listdir(tmp_path) == ["c.ckpt"]  # atomic: no tmp litter
+
+
+# ------------------------------------------------------ nanguard (3 paths)
+def test_spmd_nanguard_skip_bitwise():
+    config.set("resilience.nanguard", "skip")
+    config.set("resilience.faults", "nan:1@step=4")
+    batches = _spmd_batches(8)
+    tr = _make_spmd("g0_")
+    losses = [float(tr.step(x, y)) for x, y in batches]
+    resilience.poll_streaks(block=True)
+    assert np.isnan(losses[3]) and not np.isnan(losses[4])
+    assert telemetry.counter("spmd.nonfinite_steps").value == 1
+
+    config.set("resilience.faults", "")
+    resilience.reset_nanguard()
+    tr2 = _make_spmd("g1_")
+    for i, (x, y) in enumerate(batches):
+        if i == 3:
+            continue  # the guarded run must behave as if step 4 never ran
+        tr2.step(x, y)
+    a = [np.asarray(v) for _, v in sorted(tr.params.items())]
+    b = [np.asarray(v) for _, v in sorted(tr2.params.items())]
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def test_spmd_nanguard_abort_dumps_and_checkpoints(tmp_path):
+    config.set("resilience.nanguard", "abort")
+    config.set("resilience.faults", "nan:1@step=2")
+    config.set("tracing.watchdog_dir", str(tmp_path))
+    try:
+        mgr = resilience.CheckpointManager(str(tmp_path / "ck"))
+        tr = _make_spmd("g2_")
+        tr.attach_checkpoint_manager(mgr, auto_resume=False)
+        batches = _spmd_batches(6)
+        with pytest.raises(resilience.NonFiniteStepError,
+                           match="non-finite"):
+            for x, y in batches:
+                tr.step(x, y)
+                resilience.poll_streaks(block=True)  # force promptness
+        # flight recorder + abort checkpoint both landed
+        reports = [p for p in os.listdir(tmp_path)
+                   if p.startswith("watchdog_report_")]
+        assert reports
+        assert mgr.latest() is not None
+    finally:
+        config.set("tracing.watchdog_dir", "")
+
+
+def test_module_fused_nanguard_skip_bitwise():
+    def run(poison_step=None, skip_step=None):
+        config.set("resilience.nanguard", "skip")
+        resilience.reset_nanguard()
+        mx.random.seed(0)
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+        out = mx.sym.SoftmaxOutput(h, label, name="softmax")
+        mod = mx.mod.Module(out, data_names=["data"],
+                            label_names=["softmax_label"])
+        rng = np.random.RandomState(3)
+        X = rng.randn(40, 6).astype("f4")
+        Y = (rng.rand(40) * 4).astype("f4")
+        it = mx.io.NDArrayIter(X, Y, batch_size=8,
+                               label_name="softmax_label")
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for step, batch in enumerate(it, 1):
+            if step == skip_step:
+                continue
+            if step == poison_step:
+                batch.data = [mx.nd.array(
+                    batch.data[0].asnumpy() * np.nan)]
+            mod.train_step(batch)
+        resilience.poll_streaks(block=True)
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    pa = run(poison_step=3)
+    assert telemetry.counter("module.nonfinite_steps").value == 1
+    pb = run(skip_step=3)
+    assert all(np.array_equal(pa[k], pb[k]) for k in pa)
+
+
+def test_gluon_eager_nanguard_skip_bitwise():
+    from mxnet_tpu.gluon import nn, Trainer
+    import mxnet_tpu.gluon.loss as gloss
+    from mxnet_tpu import autograd
+
+    def run(poison_step=None, skip_step=None):
+        config.set("resilience.nanguard", "skip")
+        resilience.reset_nanguard()
+        mx.random.seed(0)
+        net = nn.Dense(4, in_units=6)
+        net.initialize()
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.1})
+        L = gloss.L2Loss()
+        rng = np.random.RandomState(5)
+        for step in range(1, 7):
+            x = rng.randn(8, 6).astype("f4")
+            y = rng.randn(8, 4).astype("f4")
+            if step == skip_step:
+                continue
+            if step == poison_step:
+                x = x * np.nan
+            with autograd.record():
+                loss = L(net(mx.nd.array(x)), mx.nd.array(y))
+            loss.backward()
+            tr.step(8)
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    ga = run(poison_step=3)
+    assert telemetry.counter("gluon.nonfinite_steps").value == 1
+    gb = run(skip_step=3)
+    assert all(np.array_equal(a, b) for a, b in zip(ga, gb))
+
+
+def test_nanguard_invalid_mode_rejected():
+    with pytest.raises(ValueError):
+        config.set("resilience.nanguard", "explode")
+
+
+# ---------------------------------------------------- preemption + resume
+def test_sigterm_preemption_saves_and_resumes_bitwise(tmp_path):
+    config.set("resilience.on_preempt", "save_and_exit")
+    batches = _spmd_batches(8)
+
+    # uninterrupted baseline
+    tr = _make_spmd("p0_")
+    base_losses = [float(tr.step(x, y)) for x, y in batches]
+    base = [np.asarray(v) for _, v in sorted(tr.params.items())]
+
+    # preempted run: SIGTERM before step 5 — step 5 finishes, then the
+    # trainer checkpoints and "exits" (SystemExit 0)
+    mgr = resilience.CheckpointManager(str(tmp_path), every_n_steps=2)
+    tr2 = _make_spmd("p0_")  # same prefix: ckpt param names must match
+    tr2.attach_checkpoint_manager(mgr)
+    with pytest.raises(SystemExit) as ei:
+        for i, (x, y) in enumerate(batches):
+            if i == 4:
+                os.kill(os.getpid(), signal.SIGTERM)
+            tr2.step(x, y)
+    assert ei.value.code == 0
+    assert telemetry.counter("resilience.preemptions").value == 1
+    assert mgr.latest()[0] == 5  # the in-flight step was checkpointed
+
+    # fresh process analog: auto-resume and replay the tail
+    config.set("resilience.on_preempt", "")
+    tr3 = _make_spmd("p0_")
+    mgr2 = resilience.CheckpointManager(str(tmp_path), every_n_steps=2)
+    resumed = tr3.attach_checkpoint_manager(mgr2)
+    assert resumed == 5
+    tail = [float(tr3.step(x, y)) for x, y in batches[5:]]
+    assert tail == base_losses[5:]  # same loss curve ⇒ same RNG stream
+    got = [np.asarray(v) for _, v in sorted(tr3.params.items())]
+    assert all(np.array_equal(a, b) for a, b in zip(base, got))
+
+
+def test_preemption_knob_off_clears_pending_request():
+    config.set("resilience.on_preempt", "save_and_exit")
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert resilience.preempt_requested()
+    config.set("resilience.on_preempt", "")
+    assert not resilience.preempt_requested()
+
+
+# -------------------------------------------------- crash-mid-write story
+def test_crash_mid_write_previous_checkpoint_loadable(tmp_path):
+    """A writer dying mid-checkpoint (simulated by the injected
+    ckpt_write fault with retries disabled) leaves the PREVIOUS
+    checkpoint untouched and loadable — the torn temp file never
+    reaches the published name."""
+    config.set("resilience.retry_attempts", 1)
+    batches = _spmd_batches(2)
+    tr = _make_spmd("c0_")
+    tr.step(*batches[0])
+    path = str(tmp_path / "only.ckpt")
+    tr.save_checkpoint(path)
+    before = open(path, "rb").read()
+    tr.step(*batches[1])
+    config.set("resilience.faults", "ckpt_write:1")
+    with pytest.raises(OSError):
+        tr.save_checkpoint(path)
+    config.set("resilience.faults", "")
+    assert open(path, "rb").read() == before
+    tr2 = _make_spmd("c0_")
+    assert tr2.load_checkpoint(path) == 1  # still generation-1
+
+
+# ----------------------------------------------------------- chaos smoke
+def test_check_resilience_smoke():
+    """Subprocess wiring for tools/check_resilience.py — the full chaos
+    story must hold from a clean interpreter, exactly how CI invokes it."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "tools", "check_resilience.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=root)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"], report
+    assert report["resume"]["loss_curve_bitwise"], report
+    assert report["resume"]["params_bitwise"], report
+    assert report["chaos"]["io_injected"] > 0, report
