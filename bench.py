@@ -315,6 +315,11 @@ def run_bench(runs_out):
         runs_out.append({"mode": "module_train",
                          "error": "%s: %s" % (type(e).__name__, e)})
     try:
+        input_pipeline_config(runs_out, 96 if on_tpu else 48)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "input_pipeline",
+                         "error": "%s: %s" % (type(e).__name__, e)})
+    try:
         infer_config(128 if on_tpu else 16, "bfloat16",
                      100 if on_tpu else 3)
     except Exception as e:  # noqa: BLE001
@@ -449,6 +454,94 @@ def module_train_config(runs_out, fused_iters, eager_iters):
         _cfg.set("module.fused_step", "auto")
 
 
+def input_pipeline_config(runs_out, steps):
+    """Secondary: device-resident vs host-side input pipeline throughput.
+
+    The same seeded MLP + SPMDTrainer consumes the same host-prep iterator
+    (per-batch normalize + cast — the decode/augment stand-in) two ways:
+    ``PrefetchingIter`` hands the step host numpy (the trainer pays a
+    synchronous sharded device_put per step), ``DevicePrefetcher`` stages
+    batches on its background thread so the caller thread dispatches
+    immediately.  samples/s for both paths land under runs[] with mode
+    "input_pipeline" and surface as the input_pipeline_overlap secondary
+    (docs/PERF_NOTES.md input-pipeline section)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _cfg
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    BATCH, FEAT = 64, 256
+    rng = np.random.RandomState(5)
+    X = rng.randn(BATCH * 8, FEAT).astype(np.float32)
+    Y = rng.randn(BATCH * 8).astype(np.float32)
+
+    class HostPrepIter(mio.DataIter):
+        def __init__(self):
+            super().__init__(BATCH)
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i + BATCH > len(X):
+                raise StopIteration
+            lo = self.i
+            self.i += BATCH
+            d = X[lo:lo + BATCH]
+            d = (d - d.mean(axis=0)) / (d.std(axis=0) + 1e-6)
+            return mio.DataBatch([d.astype(np.float32)],
+                                 [Y[lo:lo + BATCH]], pad=0)
+
+    def l2(out, label):
+        return ((out - label.reshape((-1, 1))) ** 2).mean(axis=1)
+
+    def run(device_prefetch):
+        _cfg.set("io.device_prefetch", device_prefetch)
+        mx.random.seed(9)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(256, activation="relu"), nn.Dense(1))
+        net.initialize()
+        tr = SPMDTrainer(net, l2, "sgd", {"learning_rate": 0.01})
+        if device_prefetch:
+            feed = mio.DevicePrefetcher(
+                HostPrepIter(), placement=lambda: tr.batch_sharding,
+                buckets="full")
+        else:
+            feed = mio.PrefetchingIter(HostPrepIter())
+        loss = None
+        for b in feed:                       # warm epoch: compile + ring
+            loss = tr.step(b.data[0], b.label[0], pad=b.pad)
+        np.asarray(loss)
+        done = 0
+        t0 = time.perf_counter()
+        while done < steps:
+            feed.reset()
+            for b in feed:
+                loss = tr.step(b.data[0], b.label[0], pad=b.pad)
+                done += 1
+                if done >= steps:
+                    break
+        np.asarray(loss)                     # forced sync terminates timing
+        return BATCH * done / (time.perf_counter() - t0)
+
+    try:
+        sps_host = run(False)
+        sps_dev = run(True)
+    finally:
+        _cfg.set("io.device_prefetch", True)
+    runs_out.append({"mode": "input_pipeline", "path": "host_prefetch",
+                     "samples_s": round(sps_host, 1), "batch": BATCH,
+                     "steps": steps})
+    runs_out.append({"mode": "input_pipeline", "path": "device_prefetch",
+                     "samples_s": round(sps_dev, 1), "batch": BATCH,
+                     "steps": steps})
+    runs_out.append({"mode": "input_pipeline", "path": "overlap",
+                     "device_over_host": round(sps_dev / sps_host, 3)})
+
+
 def _summarize(runs):
     """One JSON result from the completed sweep configs (best bf16 TRAIN
     run wins — inference runs are reported in `runs` but never headline,
@@ -489,6 +582,18 @@ def _summarize(runs):
             secondary["module_mlp_train_throughput"][
                 "resilience_overhead_pct"] = \
                 mod_runs["resilience_overhead"]["overhead_pct"]
+    ip_runs = {r.get("path"): r for r in runs
+               if r.get("mode") == "input_pipeline"}
+    if "device_prefetch" in ip_runs and "host_prefetch" in ip_runs:
+        secondary["input_pipeline_overlap"] = {
+            "device_prefetch_samples_s":
+                ip_runs["device_prefetch"]["samples_s"],
+            "host_prefetch_samples_s":
+                ip_runs["host_prefetch"]["samples_s"],
+            "unit": "samples/s",
+            "device_over_host":
+                ip_runs.get("overlap", {}).get("device_over_host"),
+        }
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",
         "value": best["img_s"],

@@ -359,7 +359,37 @@ register_knob(
     "threshold for 2-bit gradient compression (kvstore."
     "set_gradient_compression), reference gradient_compression.cc:44.")
 
-# data loading
+# data loading / device-resident input pipeline (docs/PERF_NOTES.md)
+register_knob(
+    "io.device_prefetch", "MXNET_TPU_IO_DEVICE_PREFETCH", bool, True,
+    "DevicePrefetcher staging: True (default) pads + device_puts each "
+    "batch on the background prefetch thread so the training loop "
+    "receives device-resident, donation-ready arrays and never blocks on "
+    "H2D in steady state; False degrades DevicePrefetcher to host-side "
+    "prefetch only (A/B baseline and debugging).")
+register_knob(
+    "io.prefetch_depth", "MXNET_TPU_IO_PREFETCH_DEPTH", int, 2,
+    "default ring depth for DevicePrefetcher/PrefetchingIter: how many "
+    "staged batches the background thread keeps ahead of the consumer "
+    "(the dmlc::ThreadedIter buffer count analog). With jax async "
+    "dispatch 2 is enough to hide host batch prep; raise it for bursty "
+    "decode pipelines.")
+register_knob(
+    "io.decode_workers", "MXNET_TPU_IO_DECODE_WORKERS", int, 0,
+    "thread-pool size for per-sample decode/augment in mx.image.ImageIter "
+    "(RecordIO/image paths): 0 (default) decodes serially on the batch "
+    "thread; N > 0 maps samples over N workers (PIL decode releases the "
+    "GIL). Each worker read retries with backoff and draws 'io' "
+    "injected faults — the reference's preprocess_threads analog.")
+register_knob(
+    "io.pad_buckets", "MXNET_TPU_IO_PAD_BUCKETS", str, "pow2",
+    "DevicePrefetcher bucketed-padding policy for ragged (short) batches: "
+    "'full' wrap-pads every batch to the iterator batch_size (ONE shape "
+    "per epoch — zero recompiles), 'pow2' (default) pads up to the next "
+    "power-of-two row count (<= log2 distinct shapes), 'off' stages "
+    "batches at their natural shape (each ragged tail compiles a fresh "
+    "program). DataBatch.pad counts the fill rows so losses/metrics can "
+    "mask them.")
 register_knob(
     "dataloader.start_method", "MXTPU_DATALOADER_START_METHOD", str,
     "spawn",

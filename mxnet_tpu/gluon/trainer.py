@@ -146,6 +146,26 @@ class Trainer:
                 "mutated.")
         self._optimizer.set_learning_rate(lr)
 
+    def batch_placement(self):
+        """Where input batches belong for this trainer: the device the
+        parameters live on (or None → default device when parameters are
+        still deferred).  Hand this (or ``trainer.batch_placement``) to
+        ``io.DevicePrefetcher`` so the gluon training loop receives batches
+        already resident next to the weights and the forward pass never
+        triggers a synchronous H2D transfer (docs/PERF_NOTES.md)."""
+        for param in self._params:
+            if param._data is not None:
+                data = param._data
+                arr = data._data if hasattr(data, "_data") else data
+                devs = getattr(arr, "devices", None)
+                if devs is not None:
+                    devs = devs() if callable(devs) else devs
+                    devs = list(devs)
+                    if len(devs) == 1:
+                        return devs[0]
+                    return getattr(arr, "sharding", None)
+        return None
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Makes one step of parameter update
         (reference: trainer.py:305).  Feeds the ``gluon.step`` telemetry

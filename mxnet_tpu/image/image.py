@@ -541,6 +541,47 @@ class ImageIter(DataIter):
         arr = arr.transpose(2, 0, 1)  # HWC -> CHW
         return arr, _np.atleast_1d(_np.asarray(label, _np.float32))
 
+    def _decode_pool(self, workers):
+        pool = getattr(self, "_pool", None)
+        if pool is None or getattr(self, "_pool_size", 0) != workers:
+            if pool is not None:
+                pool.shutdown(wait=False)
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=workers,
+                                      thread_name_prefix="mx-decode")
+            self._pool = pool
+            self._pool_size = workers
+        return pool
+
+    def _decode_positions(self, positions):
+        """Decode + augment the samples at the given epoch positions.
+
+        ``io.decode_workers`` > 1 maps them over a shared thread pool (PIL
+        decode releases the GIL — the reference's preprocess_threads
+        analog); otherwise decodes serially on the calling thread.  Either
+        way each read retries transient I/O errors with backoff and draws
+        injected 'io' faults (docs/RESILIENCE.md), and pool workers carry
+        the caller's tracing context so decode spans keep their parentage.
+        """
+        from .. import config as _config
+        from .. import resilience as _resilience
+        from .. import tracing as _tracing
+
+        def read(pos):
+            return _resilience.call_with_retry(
+                self._read_sample, pos, kind="io", inject_faults=True)
+
+        workers = int(_config.get("io.decode_workers") or 0)
+        if workers <= 1 or len(positions) <= 1:
+            return [read(p) for p in positions]
+        pool = self._decode_pool(workers)
+        with _tracing.span("io.decode", cat="io", workers=workers):
+            # wrap_context per submit: each job gets its OWN context copy
+            # (a shared copy cannot be entered by two threads at once)
+            jobs = [pool.submit(_tracing.wrap_context(read), p)
+                    for p in positions]
+            return [j.result() for j in jobs]
+
     def _batch_samples(self):
         """One batch of decoded samples: ``([(slot, data, label), ...],
         pad)`` with the wrap-pad of short final batches applied.  The
@@ -552,21 +593,20 @@ class ImageIter(DataIter):
         if self._last_batch_handle == "discard" and n - self.cur < \
                 self.batch_size:
             raise StopIteration
-        out = []
+        slots = []  # (batch slot, epoch position)
         pad = 0
         i = 0
-        while i < self.batch_size:
-            if self.cur >= n:
-                pad = self.batch_size - i
-                for j in range(i, self.batch_size):  # wrap-pad
-                    d, l = self._read_sample(j % max(i, 1))
-                    out.append((j, d, l))
-                break
-            d, l = self._read_sample(self.cur)
-            out.append((i, d, l))
+        while i < self.batch_size and self.cur < n:
+            slots.append((i, self.cur))
             self.cur += 1
             i += 1
-        return out, pad
+        if i < self.batch_size:
+            pad = self.batch_size - i
+            for j in range(i, self.batch_size):  # wrap-pad from epoch start
+                slots.append((j, j % max(i, 1)))
+        decoded = self._decode_positions([pos for _, pos in slots])
+        return [(slot, d, l)
+                for (slot, _), (d, l) in zip(slots, decoded)], pad
 
     def next(self):
         C, H, W = self.data_shape

@@ -14,6 +14,7 @@ mxnet_tpu.recordio.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time as _time
@@ -24,11 +25,15 @@ import numpy as _np
 from . import telemetry as _telemetry
 from . import resilience as _resilience
 from .ndarray.ndarray import NDArray, _wrap
+import jax
 import jax.numpy as jnp
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "MNISTIter", "LibSVMIter",
-           "ImageDetRecordIter", "ImageRecordIter"]
+           "ResizeIter", "PrefetchingIter", "DevicePrefetcher", "MNISTIter",
+           "LibSVMIter", "ImageDetRecordIter", "ImageRecordIter",
+           "ensure_staged", "is_staged"]
+
+_LOG = logging.getLogger("mxnet_tpu.io")
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -326,27 +331,170 @@ class ResizeIter(DataIter):
             return self.data_iter.next()
 
 
+# --------------------------------------------------------------------- #
+# device staging — sharded H2D placement helpers (DALI/tf.data analog:
+# the accelerator only ever sees decoded, padded, device-resident batches)
+# --------------------------------------------------------------------- #
+
+def _as_sharding(placement):
+    """Normalize a placement spec to a jax Sharding (or None = default
+    device).  Accepts None, a ``jax.Device``, any ``jax.sharding.Sharding``,
+    or a zero-arg callable returning one of those (lazy resolution, e.g.
+    ``lambda: trainer.batch_sharding`` before the trainer built its mesh)."""
+    if placement is None:
+        return None
+    if callable(placement) and not isinstance(placement,
+                                              jax.sharding.Sharding):
+        placement = placement()
+        if placement is None:
+            return None
+    if isinstance(placement, jax.Device):
+        return jax.sharding.SingleDeviceSharding(placement)
+    return placement
+
+
+def _matches_sharding(x, sharding):
+    """True if jax array ``x`` already lives under ``sharding``."""
+    if sharding is None:
+        return True
+    try:
+        return x.sharding.is_equivalent_to(sharding, x.ndim)
+    except Exception:
+        return x.sharding == sharding
+
+
+def is_staged(x, placement=None):
+    """True if ``x`` is already a device-resident jax array placed per
+    ``placement`` (any device when ``placement`` is None)."""
+    if isinstance(x, NDArray):
+        x = x._data
+    if not isinstance(x, jax.Array):
+        return False
+    return _matches_sharding(x, _as_sharding(placement))
+
+
+def _stage_put(x, sharding, source):
+    """One instrumented ``jax.device_put``: host memory (or a mis-placed
+    device array) goes STRAIGHT to its final sharding — never through an
+    intermediate commit to the default device (the double-copy this PR
+    removes from ``SPMDTrainer._step_impl``)."""
+    from . import tracing as _tracing
+    if isinstance(x, NDArray):
+        x = x._data
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    t0 = _time.perf_counter()
+    with _tracing.span("io.h2d", cat="io", source=source, bytes=nbytes):
+        out = (jax.device_put(x, sharding) if sharding is not None
+               else jax.device_put(x))
+    _telemetry.timer("io.h2d_ms").observe((_time.perf_counter() - t0) * 1e3)
+    _telemetry.counter("io.staged_bytes").inc(nbytes)
+    return out
+
+
+def ensure_staged(x, placement=None, source="step"):
+    """Return ``x`` as a device-resident jax array under ``placement``.
+
+    Already-staged inputs (e.g. from a :class:`DevicePrefetcher`) pass
+    through untouched — zero copies.  Anything else is fed straight to the
+    sharded ``jax.device_put`` and counted as a SYNCHRONOUS caller-thread
+    transfer (``io.h2d_sync`` + ``io.h2d_sync.<source>`` counters, next to
+    the ``io.h2d_ms`` timer): in steady state with device prefetch on these
+    counters must stay flat, which is how tests assert the hot loop never
+    blocks on H2D.
+    """
+    if isinstance(x, NDArray):
+        x = x._data
+    sharding = _as_sharding(placement)
+    if isinstance(x, jax.Array) and _matches_sharding(x, sharding):
+        return x
+    _telemetry.counter("io.h2d_sync").inc()
+    _telemetry.counter("io.h2d_sync." + source).inc()
+    return _stage_put(x, sharding, source)
+
+
+def _bucket_sizes(policy, batch_size):
+    """Row-count buckets a ragged batch may be padded up to.
+
+    ``"full"``  → one bucket: ``batch_size`` (zero recompiles per epoch),
+    ``"pow2"``  → powers of two up to ``batch_size`` (≤ log2 N shapes),
+    ``"off"``   → no padding (each ragged tail compiles a fresh program).
+    """
+    policy = str(policy or "off").strip().lower()
+    if policy in ("off", "none", ""):
+        return ()
+    if policy == "full":
+        return (batch_size,)
+    if policy == "pow2":
+        sizes, b = [], 1
+        while b < batch_size:
+            sizes.append(b)
+            b *= 2
+        sizes.append(batch_size)
+        return tuple(sizes)
+    raise ValueError(
+        "io.pad_buckets must be 'off', 'full' or 'pow2', got %r" % (policy,))
+
+
+def _shutdown_prefetch_worker(thread, stop_event, q, deadline_s=5.0):
+    """Stop a prefetch worker with a HARD deadline.
+
+    Sets the stop event, keeps the ring drained so a blocked ``put``
+    unblocks, and joins in slices until ``deadline_s``.  A worker that still
+    won't die is surfaced (``io.prefetch_thread_leaked`` counter + warning)
+    instead of silently re-creating the queue next to a live thread.
+    Returns True if the worker exited."""
+    stop_event.set()
+    if thread is None:
+        return True
+    deadline = _time.perf_counter() + deadline_s
+    while thread.is_alive():
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        remaining = deadline - _time.perf_counter()
+        if remaining <= 0:
+            break
+        thread.join(timeout=min(0.2, remaining))
+    if thread.is_alive():
+        _telemetry.counter("io.prefetch_thread_leaked").inc()
+        _LOG.warning(
+            "prefetch worker did not stop within %.1fs and was leaked; "
+            "the daemon thread will die with the process but its iterator "
+            "state is now untrusted (io.prefetch_thread_leaked counter)",
+            deadline_s)
+        return False
+    return True
+
+
 class PrefetchingIter(DataIter):
     """Background-thread double buffering — the dmlc::ThreadedIter analog
     (src/io/iter_prefetcher.h:66,142).  Overlaps host batch prep with device
     compute; with jax async dispatch one prefetch depth is enough to keep the
     chip fed."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 depth=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
+        if depth is None:
+            from . import config as _config
+            depth = _config.get("io.prefetch_depth")
         self.iters = iters
-        self._queue = queue.Queue(maxsize=depth)
+        self._queue = queue.Queue(maxsize=max(1, int(depth)))
         self._stop = threading.Event()
         self._thread = None
         self._start()
 
     def _start(self):
         from . import tracing as _tracing
+        stop = self._stop
+        q = self._queue
 
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     with _tracing.span("io.prefetch", cat="io"):
                         batches = [
@@ -354,9 +502,9 @@ class PrefetchingIter(DataIter):
                                 it.next, kind="io", inject_faults=True)
                             for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
-                self._queue.put(batches[0] if len(batches) == 1 else batches)
+                q.put(batches[0] if len(batches) == 1 else batches)
 
         # wrap_context snapshots the caller's contextvars so prefetch spans
         # keep the parent trace id across the thread hop
@@ -373,15 +521,7 @@ class PrefetchingIter(DataIter):
         return self.iters[0].provide_label
 
     def reset(self):
-        self._stop.set()
-        # drain so the worker unblocks, then restart
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        _shutdown_prefetch_worker(self._thread, self._stop, self._queue)
         for it in self.iters:
             it.reset()
         self._exhausted = False
@@ -400,6 +540,226 @@ class PrefetchingIter(DataIter):
             self._exhausted = True
             raise StopIteration
         return item
+
+
+class _WorkerFailure:
+    """Queue sentinel carrying an exception out of the prefetch worker so
+    the consumer re-raises it instead of hanging on an empty ring."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class DevicePrefetcher(DataIter):
+    """Device-side prefetch: the training loop's tf.data/DALI analog.
+
+    Wraps any :class:`DataIter` and, on a background thread (tracing
+    ``wrap_context`` preserved, batch pulls under PR-4 retry/fault
+    injection), (1) wrap-pads ragged batches up to a small set of bucketed
+    row counts — ``DataBatch.pad`` counts the fill rows so losses/metrics
+    can mask them — and (2) performs the sharded ``jax.device_put`` against
+    the consumer's placement (a ``NamedSharding``, device, or lazy callable
+    such as ``trainer.batch_sharding``).  The consumer pops a depth-N ring
+    of device-resident, donation-ready batches: ``Module._run_fused``,
+    ``SPMDTrainer.step`` and ``gluon.Trainer`` see pre-placed arrays and the
+    caller thread never blocks on H2D in steady state (``io.h2d_sync`` stays
+    flat; transfers count under ``io.h2d_async``).
+
+    Knobs: ``io.device_prefetch`` gates staging (off = host-side prefetch
+    A/B baseline), ``io.prefetch_depth`` sizes the ring, ``io.pad_buckets``
+    picks the bucket policy.  Telemetry: ``io.h2d_ms`` timer,
+    ``io.staged_bytes``, ``io.ring_occupancy`` gauge,
+    ``io.pad_recompiles_avoided``, plus ``io.h2d`` spans in the trace.
+    """
+
+    def __init__(self, iters, placement=None, depth=None, buckets=None,
+                 rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        from . import config as _config
+        if depth is None:
+            depth = _config.get("io.prefetch_depth")
+        if buckets is None:
+            buckets = _config.get("io.pad_buckets")
+        self.iters = iters
+        self._placement = placement
+        self._buckets = _bucket_sizes(buckets, self.batch_size)
+        self._seen_shapes = set()
+        self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = None
+        self._exhausted = False
+        self._start()
+
+    # ---------------------------------------------------------- padding
+    def _rows(self, batch):
+        for arr in batch.data:
+            shape = getattr(arr, "shape", None)
+            if shape:
+                return int(shape[0])
+        return None
+
+    def _pad_rows(self, arr, target):
+        """Wrap-pad ``arr`` along axis 0 up to ``target`` rows — the
+        NDArrayIter roll-over semantics, so fill rows hold real (repeated)
+        samples and stay in-distribution for unmasked consumers."""
+        raw = arr._data if isinstance(arr, NDArray) else arr
+        host = _np.asarray(raw)
+        n = host.shape[0]
+        idx = _np.arange(target - n) % max(n, 1)
+        out = _np.concatenate([host, host[idx]], axis=0)
+        return _wrap(jnp.asarray(out)) if isinstance(arr, NDArray) else out
+
+    def _pad_to_bucket(self, batch):
+        if not self._buckets:
+            return batch
+        n = self._rows(batch)
+        if n is None:
+            return batch
+        target = next((b for b in self._buckets if b >= n), None)
+        if target is None or target == n:
+            return batch
+        add = target - n
+        try:
+            data = [self._pad_rows(a, target) for a in batch.data]
+            label = [self._pad_rows(a, target) for a in batch.label]
+        except Exception:
+            # non-dense payloads (e.g. CSR batches) stage at natural shape
+            return batch
+        shape_key = tuple(tuple(getattr(a, "shape", ())) for a in data)
+        if shape_key in self._seen_shapes:
+            # this ragged tail would have compiled a fresh program
+            _telemetry.counter("io.pad_recompiles_avoided").inc()
+        return DataBatch(
+            data, label, pad=int(batch.pad or 0) + add, index=batch.index,
+            provide_data=self._repad_descs(batch.provide_data, target),
+            provide_label=self._repad_descs(batch.provide_label, target))
+
+    @staticmethod
+    def _repad_descs(descs, rows):
+        if not descs:
+            return descs
+        out = []
+        for d in descs:
+            if isinstance(d, DataDesc):
+                out.append(DataDesc(d.name, (rows,) + tuple(d.shape[1:]),
+                                    d.dtype, d.layout))
+            else:
+                name, shape = d[0], tuple(d[1])
+                out.append((name, (rows,) + shape[1:]) + tuple(d[2:]))
+        return out
+
+    # ---------------------------------------------------------- staging
+    def _stage_batch(self, batch, sharding):
+        batch.data = [self._stage_one(a, sharding) for a in batch.data]
+        batch.label = [self._stage_one(a, sharding) for a in batch.label]
+        return batch
+
+    def _stage_one(self, a, sharding):
+        raw = a._data if type(a) is NDArray else a
+        if not isinstance(raw, (jax.Array, _np.ndarray)):
+            return a  # sparse / exotic payloads pass through host-side
+        if isinstance(raw, jax.Array) and _matches_sharding(raw, sharding):
+            return a
+        _telemetry.counter("io.h2d_async").inc()
+        staged = _stage_put(raw, sharding, "prefetch")
+        return _wrap(staged) if isinstance(a, NDArray) else staged
+
+    def _record_shapes(self, batch):
+        self._seen_shapes.add(
+            tuple(tuple(getattr(a, "shape", ())) for a in batch.data))
+
+    # ----------------------------------------------------------- worker
+    def _start(self):
+        from . import tracing as _tracing
+        from . import config as _config
+        stop = self._stop
+        q = self._queue
+
+        def put(item):
+            # bounded put that gives up when reset() is tearing us down,
+            # so the worker can never deadlock against a full ring
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            sharding = _NOT_RESOLVED
+            while not stop.is_set():
+                try:
+                    with _tracing.span("io.prefetch", cat="io"):
+                        batches = [
+                            _resilience.call_with_retry(
+                                it.next, kind="io", inject_faults=True)
+                            for it in self.iters]
+                        batches = [self._pad_to_bucket(b) for b in batches]
+                        if _config.get("io.device_prefetch"):
+                            if sharding is _NOT_RESOLVED:
+                                sharding = _as_sharding(self._placement)
+                            batches = [self._stage_batch(b, sharding)
+                                       for b in batches]
+                        for b in batches:
+                            self._record_shapes(b)
+                except StopIteration:
+                    put(None)
+                    return
+                except BaseException as exc:  # surface, don't hang consumer
+                    put(_WorkerFailure(exc))
+                    return
+                if not put(batches[0] if len(batches) == 1 else batches):
+                    return
+
+        self._thread = threading.Thread(
+            target=_tracing.wrap_context(worker), daemon=True,
+            name="mx-device-prefetch")
+        self._thread.start()
+
+    # --------------------------------------------------------- consumer
+    @property
+    def provide_data(self):
+        return self.iters[0].provide_data
+
+    @property
+    def provide_label(self):
+        return self.iters[0].provide_label
+
+    def reset(self):
+        _shutdown_prefetch_worker(self._thread, self._stop, self._queue)
+        for it in self.iters:
+            it.reset()
+        self._exhausted = False
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._queue.maxsize)
+        self._start()
+
+    def next(self):
+        if self._exhausted:
+            raise StopIteration
+        # occupancy sampled at consume time: pinned at 0 means the staging
+        # thread can't keep ahead of the training loop
+        _telemetry.gauge("io.ring_occupancy").set(self._queue.qsize())
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, _WorkerFailure):
+            self._exhausted = True
+            raise item.exc
+        return item
+
+
+class _NotResolved:
+    """Sentinel: placement not yet resolved on the worker thread."""
+
+
+_NOT_RESOLVED = _NotResolved()
 
 
 class LibSVMIter(DataIter):

@@ -272,14 +272,19 @@ class Module(BaseModule):
         import jax
         exec_ = self._exec
         optimizer = self._optimizer
+        # ensure_staged: device-resident feeds (NDArray or DevicePrefetcher
+        # output) pass through with zero copies; host numpy goes straight to
+        # device_put and is counted as a synchronous caller-thread transfer
+        # (io.h2d_sync.module — flat in steady state with device prefetch on)
+        from .. import io as _io
         feeds = {}
         for (name, _), arr in zip(self._data_shapes, data_batch.data):
             feeds[name] = arr._data if isinstance(arr, NDArray) \
-                else jnp.asarray(arr)
+                else _io.ensure_staged(arr, source="module")
         if self._label_shapes and data_batch.label:
             for (name, _), arr in zip(self._label_shapes, data_batch.label):
                 feeds[name] = arr._data if isinstance(arr, NDArray) \
-                    else jnp.asarray(arr)
+                    else _io.ensure_staged(arr, source="module")
         exec_._feed_inputs(feeds)  # arg_dict state matches the eager path
         req = exec_.grad_req
         wrt = tuple(sorted(n for n in exec_.arg_dict

@@ -123,7 +123,7 @@ class SPMDTrainer:
         self.params = None
         self.opt_state = None
         self._step_num = 0
-        self._jitted = None
+        self._jitted = {}   # masked(bool) -> jitted program (one guard mode)
         self._donate = donate
         # resilience (docs/RESILIENCE.md): optional CheckpointManager for
         # periodic save / preemption save / auto-resume, plus the nanguard
@@ -234,14 +234,28 @@ class SPMDTrainer:
                 self.opt_state[n] = jax.tree_util.tree_map(
                     lambda x: jax.device_put(x, sh), self.opt_state[n])
 
+    @property
+    def batch_sharding(self):
+        """The ``NamedSharding`` the fused step expects batches under (rows
+        split along the batch axis).  Available BEFORE the first compile —
+        hand it (or ``lambda: trainer.batch_sharding``) to
+        ``io.DevicePrefetcher`` so batches arrive pre-placed and ``step``
+        never issues a synchronous ``device_put``."""
+        sh = getattr(self, "_batch_sharding", None)
+        if sh is None:
+            sh = NamedSharding(self.mesh, P(self.batch_axis))
+            self._batch_sharding = sh
+        return sh
+
     # ------------------------------------------------------------ step build
-    def _build(self):
+    def _build(self, pad=0):
+        masked = pad > 0
         fn = self.fn
         loss_fn = self.loss_fn
         optimizer = self.optimizer
         trainable = fn.trainable
         mesh = self.mesh
-        batch_sh = NamedSharding(mesh, P(self.batch_axis))
+        batch_sh = self.batch_sharding
         param_sh = {n: NamedSharding(mesh, self._spec_for(n))
                     for n in fn.params}
 
@@ -267,7 +281,10 @@ class SPMDTrainer:
                 _nn_ops.set_hwio_weights(prev)
             if cdt is not None:
                 out = out.astype(jnp.float32)
-            loss = _as_scalar_loss(loss_fn, out, label)
+            if masked:
+                loss = _as_masked_scalar_loss(loss_fn, out, label, pad)
+            else:
+                loss = _as_scalar_loss(loss_fn, out, label)
             return loss, (new_aux, out)
 
         guard = self._guard_mode
@@ -320,8 +337,15 @@ class SPMDTrainer:
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------ public
-    def step(self, data, label, lr_scale=1.0):
+    def step(self, data, label, lr_scale=1.0, pad=0):
         """Run one fused train step; returns the (device-resident) loss.
+
+        ``pad`` is the number of trailing fill rows in the batch
+        (``DataBatch.pad`` from bucketed padding, docs/PERF_NOTES.md): when
+        non-zero the step runs a pad-MASKED program whose loss/gradients
+        average over the first ``rows - pad`` samples only, so wrap-padded
+        rows contribute exactly nothing.  Requires ``loss_fn`` to return
+        per-sample (batch-unreduced) losses.
 
         Feeds the ``spmd.step`` telemetry timer every call; with the JSONL
         step log enabled each step also emits one record carrying the
@@ -336,6 +360,7 @@ class SPMDTrainer:
             data = data._data
         if isinstance(label, NDArray):
             label = label._data
+        pad = int(pad or 0)
         # nanguard escalation check: a dict lookup per step; raises
         # NonFiniteStepError (after flight-recorder dump + checkpoint)
         # once the device reported K consecutive bad steps
@@ -345,14 +370,14 @@ class SPMDTrainer:
                 "nan", step=self._step_num + 1):
             data = _resilience.poison_batch(data)
         with _telemetry.step_scope(
-                "spmd", samples=int(data.shape[0]) if
+                "spmd", samples=int(data.shape[0]) - pad if
                 getattr(data, "ndim", 0) else None,
                 shape=tuple(getattr(data, "shape", ())) or None,
                 mesh={n: int(s) for n, s in zip(self.mesh.axis_names,
                                                 self.mesh.devices.shape)},
                 default_path="fused"), \
                 _tracing.span("spmd.step", cat="spmd"):
-            loss = self._step_impl(data, label, lr_scale)
+            loss = self._step_impl(data, label, lr_scale, pad)
         if self._ckpt_manager is not None:
             self._ckpt_manager.maybe_save(self._step_num,
                                           self.save_checkpoint)
@@ -362,26 +387,38 @@ class SPMDTrainer:
             _resilience.exit_on_preempt(save_fn=self._preempt_save)
         return loss
 
-    def _step_impl(self, data, label, lr_scale):
+    def _step_impl(self, data, label, lr_scale, pad=0):
+        from .. import io as _io
         from .. import resilience as _resilience
         from .. import tracing as _tracing
         if self.params is None:
             self._materialize(data)
         guard = _resilience.nanguard_mode()
-        if self._jitted is not None and guard != self._guard_mode:
-            self._jitted = None  # knob flip: rebuild with/without the guard
-        if self._jitted is None:
+        if self._jitted and guard != self._guard_mode:
+            self._jitted.clear()  # knob flip: rebuild with/without the guard
+        # the program cache is keyed by pad count: the pad-masked loss uses
+        # a STATIC slice so its reduction is structurally identical to the
+        # unpadded program's (bitwise-equal losses) — each distinct tail
+        # size costs one compile, bounded by the bucket policy
+        jitted = self._jitted.get(pad)
+        if jitted is None:
             self._guard_mode = guard
             with _tracing.span("spmd.compile", cat="spmd"):
-                self._jitted = self._build()
+                jitted = self._jitted[pad] = self._build(pad)
             from .. import profiler as _profiler
             _profiler.counter_increment("fused_compiles")
         # the batch shard_put is the host->mesh boundary; the gradient
         # allreduce itself is a compiler-scheduled psum INSIDE the jitted
-        # step (visible on the device plane of a merged trace, not here)
+        # step (visible on the device plane of a merged trace, not here).
+        # ensure_staged feeds host numpy STRAIGHT to the sharded device_put
+        # (no intermediate default-device commit) and is a NO-OP for batches
+        # a DevicePrefetcher already placed — steady-state steps then do
+        # zero synchronous H2D here (io.h2d_sync.spmd stays flat).
         with _tracing.span("spmd.shard_batch", cat="spmd"):
-            data = jax.device_put(jnp.asarray(data), self._batch_sharding)
-            label = jax.device_put(jnp.asarray(label), self._batch_sharding)
+            data = _io.ensure_staged(data, self._batch_sharding,
+                                     source="spmd")
+            label = _io.ensure_staged(label, self._batch_sharding,
+                                      source="spmd")
         self._step_num += 1
         self.optimizer.num_update = self._step_num
         if not hasattr(self, "_hyper_cache"):
@@ -405,13 +442,13 @@ class SPMDTrainer:
             if self._nan_streak is None:
                 self._nan_streak = jnp.zeros((), jnp.int32)
             new_train, new_aux, self.opt_state, loss, self._nan_streak = \
-                self._jitted(train, aux, self.opt_state, data, label, key,
-                             jnp.asarray(self._step_num, jnp.int32), lrs,
-                             wds, sarr, self._nan_streak)
+                jitted(train, aux, self.opt_state, data, label, key,
+                       jnp.asarray(self._step_num, jnp.int32), lrs,
+                       wds, sarr, self._nan_streak)
             # no-sync host inspection of completed steps' streaks
             _resilience.watch_streak("spmd", self._nan_streak)
         else:
-            new_train, new_aux, self.opt_state, loss = self._jitted(
+            new_train, new_aux, self.opt_state, loss = jitted(
                 train, aux, self.opt_state, data, label, key,
                 jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
         from .. import profiler as _profiler
@@ -647,7 +684,7 @@ def _preprocess(optimizer, grad):
     return g
 
 
-def _as_scalar_loss(loss_fn, out, label):
+def _raw_loss(loss_fn, out, label):
     from ..ndarray.ndarray import NDArray, _wrap
     try:
         loss = loss_fn(_wrap(out), _wrap(label))
@@ -655,7 +692,30 @@ def _as_scalar_loss(loss_fn, out, label):
     except (TypeError, AttributeError):
         loss = loss_fn(out, label)
         loss = loss._data if isinstance(loss, NDArray) else loss
-    return jnp.mean(loss.astype(jnp.float32))
+    return loss.astype(jnp.float32)
+
+
+def _as_scalar_loss(loss_fn, out, label):
+    return jnp.mean(_raw_loss(loss_fn, out, label))
+
+
+def _as_masked_scalar_loss(loss_fn, out, label, pad):
+    """Mean loss over all but the last ``pad`` rows: trailing fill rows
+    (bucketed padding, ``DataBatch.pad``) contribute nothing to loss OR
+    gradients.  ``pad`` is STATIC — the slice makes the reduction
+    structurally identical to the unpadded program's ``jnp.mean``, so the
+    masked loss matches the unpadded loss bitwise (a traced mask would
+    reduce over the padded length and drift in the last ulp)."""
+    loss = _raw_loss(loss_fn, out, label)
+    if loss.ndim == 0:
+        raise ValueError(
+            "pad-masked step needs per-sample losses: loss_fn reduced over "
+            "the batch already — return unreduced losses or drop pad=")
+    valid = int(loss.shape[0]) - int(pad)
+    if valid <= 0:
+        raise ValueError("pad=%d leaves no valid rows in a %d-row batch"
+                         % (pad, int(loss.shape[0])))
+    return jnp.mean(loss[:valid])
 
 
 def build_train_step(block, loss_fn, optimizer, optimizer_params=None,

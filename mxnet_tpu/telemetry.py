@@ -366,7 +366,8 @@ class step_scope:
             self._before = (counter("fused_steps").value,
                             counter("eager_steps").value,
                             counter("fused_compiles").value,
-                            counter("host_syncs").value)
+                            counter("host_syncs").value,
+                            counter("io.h2d_sync").value)
         else:
             self._before = None
         self._t0 = time.perf_counter()
@@ -409,6 +410,10 @@ class step_scope:
             if samples and dt > 0 else None,
             compiles=counter("fused_compiles").value - self._before[2],
             host_syncs=counter("host_syncs").value - self._before[3],
+            # caller-thread H2D transfers inside this step: non-zero in
+            # steady state means batches are NOT arriving device-resident
+            # (docs/PERF_NOTES.md input pipeline)
+            h2d_sync=counter("io.h2d_sync").value - self._before[4],
             mem_bytes=device_memory_bytes(),
             shape=list(self.shape) if self.shape else None,
             mesh=dict(self.mesh) if self.mesh else None,
@@ -449,7 +454,7 @@ _STEP_REQUIRED = {"event": str, "ts": (int, float), "source": str,
                   "compiles": int, "host_syncs": int}
 _STEP_OPTIONAL = {"samples": int, "samples_per_s": (int, float),
                   "mem_bytes": int, "shape": list, "mesh": dict,
-                  "error": str}
+                  "h2d_sync": int, "error": str}
 
 
 def validate_step_record(rec):

@@ -16,7 +16,13 @@ device memory.  Anomaly flags:
     straggler): host sync stalls or input pipeline hiccups dominate the
     tail;
   * falling throughput — second-half mean samples/s < 70% of first-half
-    over >= 10 steps: the run is slowing down (leak, growing host work).
+    over >= 10 steps: the run is slowing down (leak, growing host work);
+  * sync H2D reappeared — after >= 5 consecutive steady steps with zero
+    caller-thread transfers (a device-resident input pipeline,
+    io.DevicePrefetcher), later steps report h2d_sync > 0: the prefetch
+    ring fell behind or a batch bypassed staging.  Runs that ALWAYS do
+    synchronous H2D (host-side prefetch) are their normal mode, not
+    flagged.
 
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # tables + flags
@@ -95,6 +101,7 @@ def summarize(records):
                if isinstance(r.get("samples_per_s"), (int, float))]
         compiles = sum(int(r.get("compiles") or 0) for r in recs)
         syncs = sum(int(r.get("host_syncs") or 0) for r in recs)
+        h2d_sync = sum(int(r.get("h2d_sync") or 0) for r in recs)
         mems = [int(r["mem_bytes"]) for r in recs
                 if isinstance(r.get("mem_bytes"), int)]
         paths = {}
@@ -116,10 +123,30 @@ def summarize(records):
             if sps else None,
             "compiles": compiles,
             "host_syncs": syncs,
+            "sync_h2d": h2d_sync,
             "peak_mem_bytes": max(mems) if mems else None,
             "distinct_shapes": len(shapes),
         }
         sources[source] = table
+
+        # sync H2D reappearing after the pipeline proved device-resident
+        h2d_steady = [int(r.get("h2d_sync") or 0) for r in recs
+                      if not r.get("compiles")]
+        zeros_run, established, reappeared = 0, False, 0
+        for v in h2d_steady:
+            if v == 0:
+                zeros_run += 1
+                established = established or zeros_run >= 5
+            else:
+                zeros_run = 0
+                if established:
+                    reappeared += v
+        if reappeared:
+            anomalies.append({
+                "kind": "sync_h2d_steady", "source": source,
+                "detail": "%d caller-thread H2D transfer(s) after the run "
+                          "reached steady-state device-resident input"
+                          % reappeared})
 
         # recompile churn: each distinct feed signature legitimately costs
         # one compile; anything beyond that is retracing at a fixed shape
@@ -170,7 +197,8 @@ def render(summary, bad_lines=0):
                         t["distinct_shapes"]))
         path_str = ", ".join("%s=%d" % kv for kv in
                              sorted(t["paths"].items()))
-        lines.append("         paths: %s" % path_str)
+        lines.append("         paths: %s | sync_h2d=%d"
+                     % (path_str, t.get("sync_h2d", 0)))
     if not summary["sources"]:
         lines.append("(no step records)")
     if summary["monitor_events"]:
