@@ -377,10 +377,11 @@ register_knob(
 register_knob(
     "io.decode_workers", "MXNET_TPU_IO_DECODE_WORKERS", int, 0,
     "thread-pool size for per-sample decode/augment in mx.image.ImageIter "
-    "(RecordIO/image paths): 0 (default) decodes serially on the batch "
-    "thread; N > 0 maps samples over N workers (PIL decode releases the "
-    "GIL). Each worker read retries with backoff and draws 'io' "
-    "injected faults — the reference's preprocess_threads analog.")
+    "(RecordIO/image paths): 0 or 1 (default 0) decodes serially on the "
+    "batch thread; N > 1 maps samples over N workers (PIL decode releases "
+    "the GIL; RecordIO random reads are lock-serialized per file handle). "
+    "Each worker read retries with backoff and draws 'io' injected faults "
+    "— the reference's preprocess_threads analog.")
 register_knob(
     "io.pad_buckets", "MXNET_TPU_IO_PAD_BUCKETS", str, "pow2",
     "DevicePrefetcher bucketed-padding policy for ragged (short) batches: "

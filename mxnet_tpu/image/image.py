@@ -545,13 +545,29 @@ class ImageIter(DataIter):
         pool = getattr(self, "_pool", None)
         if pool is None or getattr(self, "_pool_size", 0) != workers:
             if pool is not None:
-                pool.shutdown(wait=False)
+                # drain in-flight decode jobs before replacing the pool so
+                # a mid-flight knob change can't abandon submitted work
+                pool.shutdown(wait=True)
             from concurrent.futures import ThreadPoolExecutor
             pool = ThreadPoolExecutor(max_workers=workers,
                                       thread_name_prefix="mx-decode")
             self._pool = pool
             self._pool_size = workers
         return pool
+
+    def close(self):
+        """Release the decode thread pool (idempotent; also runs on GC)."""
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            self._pool = None
+            self._pool_size = 0
+            pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _decode_positions(self, positions):
         """Decode + augment the samples at the given epoch positions.

@@ -440,9 +440,11 @@ def _shutdown_prefetch_worker(thread, stop_event, q, deadline_s=5.0):
 
     Sets the stop event, keeps the ring drained so a blocked ``put``
     unblocks, and joins in slices until ``deadline_s``.  A worker that still
-    won't die is surfaced (``io.prefetch_thread_leaked`` counter + warning)
-    instead of silently re-creating the queue next to a live thread.
-    Returns True if the worker exited."""
+    won't die is surfaced (``io.prefetch_thread_leaked`` counter + warning).
+    Returns True if the worker exited; on False the caller must NOT restart
+    a new worker — the leaked thread still calls ``next()`` on the inner
+    iterators, so rewinding them and consuming from a replacement would
+    race two threads on one iterator."""
     stop_event.set()
     if thread is None:
         return True
@@ -521,7 +523,12 @@ class PrefetchingIter(DataIter):
         return self.iters[0].provide_label
 
     def reset(self):
-        _shutdown_prefetch_worker(self._thread, self._stop, self._queue)
+        if not _shutdown_prefetch_worker(self._thread, self._stop,
+                                         self._queue):
+            raise RuntimeError(
+                "prefetch worker did not stop within the reset deadline; "
+                "refusing to rewind/restart while it may still consume the "
+                "inner iterators — recreate the PrefetchingIter instead")
         for it in self.iters:
             it.reset()
         self._exhausted = False
@@ -561,7 +568,10 @@ class DevicePrefetcher(DataIter):
     row counts — ``DataBatch.pad`` counts the fill rows so losses/metrics
     can mask them — and (2) performs the sharded ``jax.device_put`` against
     the consumer's placement (a ``NamedSharding``, device, or lazy callable
-    such as ``trainer.batch_sharding``).  The consumer pops a depth-N ring
+    such as ``trainer.batch_sharding`` — re-invoked every batch until it
+    yields a placement, so constructing the prefetcher before params/mesh
+    exist is safe; early batches just stay host-side).  The consumer pops
+    a depth-N ring
     of device-resident, donation-ready batches: ``Module._run_fused``,
     ``SPMDTrainer.step`` and ``gluon.Trainer`` see pre-placed arrays and the
     caller thread never blocks on H2D in steady state (``io.h2d_sync`` stays
@@ -622,12 +632,24 @@ class DevicePrefetcher(DataIter):
         target = next((b for b in self._buckets if b >= n), None)
         if target is None or target == n:
             return batch
+        if not all(isinstance(a._data if type(a) is NDArray else a,
+                              (jax.Array, _np.ndarray))
+                   for a in list(batch.data) + list(batch.label)):
+            # non-dense payloads (e.g. CSR batches) stage at natural shape
+            return batch
         add = target - n
         try:
             data = [self._pad_rows(a, target) for a in batch.data]
             label = [self._pad_rows(a, target) for a in batch.label]
-        except Exception:
-            # non-dense payloads (e.g. CSR batches) stage at natural shape
+        except (TypeError, ValueError) as exc:
+            # a dense batch that fails to wrap-pad is a real bug upstream
+            # (e.g. mismatched leading dims) — count + warn so the shape
+            # churn this re-buys is visible, never silently swallowed
+            _telemetry.counter("io.pad_fallback").inc()
+            _LOG.warning(
+                "bucketed padding failed (%s); staging batch at natural "
+                "row count %d — recompile churn possible "
+                "(io.pad_fallback counter)", exc, n)
             return batch
         shape_key = tuple(tuple(getattr(a, "shape", ())) for a in data)
         if shape_key in self._seen_shapes:
@@ -672,6 +694,17 @@ class DevicePrefetcher(DataIter):
         self._seen_shapes.add(
             tuple(tuple(getattr(a, "shape", ())) for a in batch.data))
 
+    def _resolve_placement(self):
+        """Resolve the placement spec.  Returns ``(sharding, final)`` —
+        ``final`` False means a lazy callable returned None (e.g.
+        ``lambda: trainer.batch_sharding`` before params/mesh exist) and
+        must be re-invoked on a later batch rather than cached, else every
+        batch would silently stage to the default device forever."""
+        p = self._placement
+        lazy = callable(p) and not isinstance(p, jax.sharding.Sharding)
+        sharding = _as_sharding(p)
+        return sharding, not (lazy and sharding is None)
+
     # ----------------------------------------------------------- worker
     def _start(self):
         from . import tracing as _tracing
@@ -702,9 +735,17 @@ class DevicePrefetcher(DataIter):
                         batches = [self._pad_to_bucket(b) for b in batches]
                         if _config.get("io.device_prefetch"):
                             if sharding is _NOT_RESOLVED:
-                                sharding = _as_sharding(self._placement)
-                            batches = [self._stage_batch(b, sharding)
-                                       for b in batches]
+                                resolved, final = self._resolve_placement()
+                                if final:
+                                    sharding = resolved
+                            if sharding is not _NOT_RESOLVED:
+                                batches = [self._stage_batch(b, sharding)
+                                           for b in batches]
+                            # else: the lazy placement hasn't materialized
+                            # yet — leave these batches host-side so the
+                            # consumer's ensure_staged puts them on the
+                            # REAL device (staging to the default device
+                            # here would re-buy the double copy)
                         for b in batches:
                             self._record_shapes(b)
                 except StopIteration:
@@ -731,7 +772,13 @@ class DevicePrefetcher(DataIter):
         return self.iters[0].provide_label
 
     def reset(self):
-        _shutdown_prefetch_worker(self._thread, self._stop, self._queue)
+        if not _shutdown_prefetch_worker(self._thread, self._stop,
+                                         self._queue):
+            raise RuntimeError(
+                "device-prefetch worker did not stop within the reset "
+                "deadline; refusing to rewind/restart while it may still "
+                "consume the inner iterators — recreate the "
+                "DevicePrefetcher instead")
         for it in self.iters:
             it.reset()
         self._exhausted = False

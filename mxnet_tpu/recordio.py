@@ -17,6 +17,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
 from collections import namedtuple
 
 import numpy as _np
@@ -149,6 +150,11 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx = {}
         self.keys = []
         self.key_type = key_type
+        # random access is seek()+read() on ONE shared handle: the lock
+        # keeps the pair atomic so concurrent decode workers
+        # (io.decode_workers) can't interleave seeks and read garbled
+        # records (the native mmap reader is stateless and needs none)
+        self._read_lock = threading.Lock()
         super().__init__(uri, flag)
 
     def open(self):
@@ -173,8 +179,9 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def read_idx(self, idx):
-        self.seek(self.idx[idx])
-        return self.read()
+        with self._read_lock:
+            self.seek(self.idx[idx])
+            return self.read()
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -86,6 +86,70 @@ def test_imageiter_from_rec(tmp_path):
     assert sum(1 for _ in it) == 3
 
 
+def test_indexed_recordio_concurrent_read_idx(tmp_path):
+    """read_idx is seek()+read() on one shared handle: the per-handle lock
+    keeps the pair atomic, so hammering it from a thread pool returns every
+    record intact (regression: unlocked seeks interleaved under
+    io.decode_workers and silently served garbled records)."""
+    from concurrent.futures import ThreadPoolExecutor
+    rec = str(tmp_path / "c.rec")
+    idx = str(tmp_path / "c.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx, rec, "w")
+    payloads = {i: (b"rec-%d-" % i) * (i + 1) for i in range(32)}
+    for i in range(32):
+        w.write_idx(i, payloads[i])
+    w.close()
+    r = mx.recordio.MXIndexedRecordIO(idx, rec, "r")
+    keys = [i % 32 for i in range(256)]
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        got = list(pool.map(r.read_idx, keys))
+    assert got == [payloads[k] for k in keys]
+
+
+def test_imageiter_parallel_decode_rec_path_bitwise(tmp_path):
+    """io.decode_workers on the RecordIO path matches serial decode bitwise
+    — the pooled workers share one MXIndexedRecordIO handle, whose locked
+    read_idx is what keeps their records uncorrupted."""
+    from mxnet_tpu import config
+    from mxnet_tpu.image.recordio_compat import open_indexed
+    rec = _make_rec_dataset(tmp_path)
+
+    def epoch(workers):
+        config.set("io.decode_workers", workers)
+        try:
+            # open_indexed forces the pure-python shared-handle reader (the
+            # native mmap reader is stateless and would not exercise it)
+            it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                                    imgrec=open_indexed(rec))
+            return [(np.asarray(b.data[0].asnumpy()),
+                     np.asarray(b.label[0].asnumpy())) for b in it]
+        finally:
+            config.set("io.decode_workers", 0)
+
+    serial = epoch(0)
+    pooled = epoch(4)
+    assert len(serial) == len(pooled) == 3
+    for (sd, sl), (pd, pl) in zip(serial, pooled):
+        np.testing.assert_array_equal(sd, pd)
+        np.testing.assert_array_equal(sl, pl)
+
+
+def test_imageiter_decode_pool_close(tmp_path):
+    from mxnet_tpu import config
+    rec = _make_rec_dataset(tmp_path)
+    config.set("io.decode_workers", 2)
+    try:
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                                path_imgrec=rec)
+        next(it)
+        assert it._pool is not None
+        it.close()
+        assert it._pool is None
+        it.close()  # idempotent
+    finally:
+        config.set("io.decode_workers", 0)
+
+
 def test_imageiter_sharding(tmp_path):
     """part_index/num_parts reads disjoint shards (reference:
     ImageRecordIter distributed loading)."""

@@ -192,6 +192,68 @@ def test_device_prefetcher_reset_joins_worker():
     assert telemetry.counter("io.prefetch_thread_leaked").value == leaked0
 
 
+def test_device_prefetcher_lazy_placement_resolves_late():
+    """A lazy placement callable that returns None is re-invoked on later
+    batches instead of cached (regression: None was frozen at the first
+    batch and every batch silently staged to the default device)."""
+    dev = jax.devices()[0]
+    calls = {"n": 0}
+
+    def placement():
+        calls["n"] += 1
+        return None if calls["n"] == 1 else dev
+
+    dp = mio.DevicePrefetcher(_ragged_iter(), placement=placement,
+                              buckets="full")
+    batches = list(dp)
+    assert len(batches) == 4
+    # first worker iteration saw None: that batch stays host-side so the
+    # consumer stages it to the REAL device (no default-device detour)
+    assert isinstance(batches[0].data[0], np.ndarray)
+    for b in batches[1:]:
+        assert isinstance(b.data[0], jax.Array)
+        assert mio.is_staged(b.data[0], dev)
+    assert calls["n"] == 2  # resolved on batch 2, then cached
+
+
+def test_device_prefetcher_reset_refuses_leaked_worker(monkeypatch):
+    dp = mio.DevicePrefetcher(_ragged_iter(), buckets="full")
+    next(iter(dp))
+    monkeypatch.setattr(mio, "_shutdown_prefetch_worker",
+                        lambda *a, **k: False)
+    with pytest.raises(RuntimeError, match="refusing"):
+        dp.reset()
+    dp._stop.set()  # let the (healthy) worker wind down
+
+
+def test_prefetching_iter_reset_refuses_leaked_worker(monkeypatch):
+    X = np.zeros((8, 2), np.float32)
+    pf = mio.PrefetchingIter(mx.io.NDArrayIter(X, np.zeros(8, np.float32),
+                                               batch_size=4))
+    next(iter(pf))
+    monkeypatch.setattr(mio, "_shutdown_prefetch_worker",
+                        lambda *a, **k: False)
+    with pytest.raises(RuntimeError, match="refusing"):
+        pf.reset()
+    pf._stop.set()
+
+
+def test_pad_failure_counts_fallback(monkeypatch):
+    """A dense batch that fails to wrap-pad passes through at natural
+    shape but is COUNTED (io.pad_fallback), never silently swallowed."""
+    def boom(self, arr, target):
+        raise ValueError("synthetic pad failure")
+
+    monkeypatch.setattr(mio.DevicePrefetcher, "_pad_rows", boom)
+    config.set("io.device_prefetch", False)
+    dp = mio.DevicePrefetcher(_ragged_iter(), buckets="full")
+    batches = list(dp)
+    # only the 4-row ragged tail attempts padding; it falls back unpadded
+    assert batches[-1].data[0].shape[0] == 4
+    assert batches[-1].pad == 0
+    assert telemetry.counter("io.pad_fallback").value == 1
+
+
 def test_device_prefetcher_worker_exception_propagates():
     class BoomIter(mio.DataIter):
         def __init__(self):
