@@ -325,6 +325,11 @@ def run_bench(runs_out):
     except Exception as e:  # noqa: BLE001
         runs_out.append({"mode": "inference", "dtype": "bfloat16",
                          "error": "%s: %s" % (type(e).__name__, e)})
+    try:
+        serving_config(runs_out, 512 if on_tpu else 256)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "serving",
+                         "error": "%s: %s" % (type(e).__name__, e)})
 
     result = _summarize(runs_out)
     result.update(platform=platform, device_kind=kind)
@@ -542,6 +547,91 @@ def input_pipeline_config(runs_out, steps):
                      "device_over_host": round(sps_dev / sps_host, 3)})
 
 
+def serving_config(runs_out, requests):
+    """Secondary: mx.serving continuous batching vs sequential batch-1
+    predict, requests/s under concurrent load.
+
+    The same exported MLP artifact serves the same single-row request
+    stream two ways: one thread calling ``StableHLOPredictor.predict``
+    per request (every request pays its own dispatch), and N caller
+    threads submitting into a :class:`serving.Server` whose batcher
+    coalesces them into bucketed batches (many requests amortize one
+    dispatch).  requests/s for both paths land under runs[] with mode
+    "serving" plus the server-side queue-delay p99, and surface as the
+    serving_throughput secondary (docs/SERVING.md).  PR acceptance pins
+    continuous >= 2x sequential on CPU."""
+    import tempfile
+    import threading
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import deploy, serving, telemetry
+    from mxnet_tpu.gluon import nn
+
+    FEAT, MAX_BATCH, THREADS = 64, 16, 8
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(16))
+    net.initialize()
+    example = mx.nd.random.uniform(shape=(MAX_BATCH, FEAT))
+    net(example)
+    prefix = os.path.join(tempfile.mkdtemp(prefix="mxtpu_bench_srv_"),
+                          "mlp")
+    deploy.export_model(net, prefix, example)
+
+    rng = np.random.RandomState(2)
+    reqs = [rng.uniform(size=(1, FEAT)).astype(np.float32)
+            for _ in range(requests)]
+
+    # sequential batch-1: every request is its own synchronous dispatch
+    pred = deploy.StableHLOPredictor(prefix)
+    pred.predict(reqs[0])                       # compile the batch-1 shape
+    t0 = time.perf_counter()
+    for r in reqs:
+        pred.predict(r)
+    seq_rps = requests / (time.perf_counter() - t0)
+
+    # continuous batching: THREADS submitters share one batcher
+    srv = serving.Server(max_batch=MAX_BATCH, max_queue_delay_ms=2.0)
+    srv.register("mlp", prefix)
+    srv.start()
+    try:
+        srv.predict("mlp", reqs[0])             # warm the dispatch path
+        telemetry.timer("serving.queue_delay_ms").reset()
+        telemetry.timer("serving.batch_fill").reset()
+        shards = [reqs[i::THREADS] for i in range(THREADS)]
+
+        def worker(shard):
+            for f in [srv.submit("mlp", r) for r in shard]:
+                f.result(timeout=60)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in shards]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cont_rps = requests / (time.perf_counter() - t0)
+        qd_p99 = telemetry.timer("serving.queue_delay_ms").stats()["p99"]
+        fill = telemetry.timer("serving.batch_fill").stats()
+    finally:
+        srv.stop()
+    runs_out.append({"mode": "serving", "path": "sequential_batch1",
+                     "requests": requests,
+                     "requests_s": round(seq_rps, 1)})
+    runs_out.append({"mode": "serving", "path": "continuous",
+                     "requests": requests, "threads": THREADS,
+                     "max_batch": MAX_BATCH,
+                     "requests_s": round(cont_rps, 1),
+                     "queue_delay_p99_ms": round(qd_p99, 3),
+                     "batch_fill_mean": round(
+                         fill["total"] / fill["count"], 3)
+                     if fill["count"] else None})
+    runs_out.append({"mode": "serving", "path": "speedup",
+                     "continuous_over_sequential":
+                         round(cont_rps / seq_rps, 2)})
+
+
 def _summarize(runs):
     """One JSON result from the completed sweep configs (best bf16 TRAIN
     run wins — inference runs are reported in `runs` but never headline,
@@ -593,6 +683,23 @@ def _summarize(runs):
             "unit": "samples/s",
             "device_over_host":
                 ip_runs.get("overlap", {}).get("device_over_host"),
+        }
+    srv_runs = {r.get("path"): r for r in runs
+                if r.get("mode") == "serving"}
+    if "continuous" in srv_runs and "sequential_batch1" in srv_runs:
+        secondary["serving_throughput"] = {
+            "continuous_requests_s":
+                srv_runs["continuous"]["requests_s"],
+            "sequential_batch1_requests_s":
+                srv_runs["sequential_batch1"]["requests_s"],
+            "unit": "requests/s",
+            "continuous_over_sequential":
+                srv_runs.get("speedup", {}).get(
+                    "continuous_over_sequential"),
+            "queue_delay_p99_ms":
+                srv_runs["continuous"].get("queue_delay_p99_ms"),
+            "batch_fill_mean":
+                srv_runs["continuous"].get("batch_fill_mean"),
         }
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",

@@ -64,6 +64,7 @@ _LAZY = {
     "native": ".native",
     "contrib": ".contrib",
     "deploy": ".deploy",
+    "serving": ".serving",
     "config": ".config",
     "compat": ".compat",
     "dlpack": ".dlpack",

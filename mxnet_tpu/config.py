@@ -399,6 +399,28 @@ register_knob(
     "fork (opt-in: cheapest, but forking a live XLA runtime risks "
     "deadlock; reference dataloader.py:558 is likewise spawn-capable).")
 
+# inference serving (docs/SERVING.md)
+register_knob(
+    "serving.max_batch", "MXNET_TPU_SERVING_MAX_BATCH", int, 32,
+    "mx.serving batch capacity: the batcher coalesces queued requests "
+    "for one model up to this many rows before dispatch; also the top "
+    "pad bucket, so it bounds the compiled-program set per model.")
+register_knob(
+    "serving.max_queue_delay_ms", "MXNET_TPU_SERVING_MAX_QUEUE_DELAY_MS",
+    float, 2.0,
+    "mx.serving batching window in milliseconds: how long the batcher "
+    "holds the OLDEST queued request waiting for co-batchable traffic "
+    "before dispatching a partial batch. 0 dispatches immediately "
+    "(batch-1 under light load); raise it to trade p50 latency for "
+    "batch fill under bursty traffic.")
+register_knob(
+    "serving.compile_cache_dir", "MXNET_TPU_SERVING_COMPILE_CACHE_DIR",
+    str, "",
+    "persistent XLA compilation-cache directory wired into jax.config at "
+    "Server.start(): bucket programs compiled on a previous run reload "
+    "from disk for near-zero cold start. Empty (default) leaves the "
+    "process-level jax cache settings untouched.")
+
 # bench / testing
 register_knob(
     "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 1650.0,

@@ -15,7 +15,17 @@ Artifact layout for ``export_model(prefix)``:
   {prefix}-model.stablehlo   serialized StableHLO with embedded vjp-free
                              inference function (params are arguments)
   {prefix}-params.npz        parameter arrays in call order
-  {prefix}-meta.json         input signature + param names
+  {prefix}-meta.json         input/output signature + param names
+
+Format history (``meta["format_version"]``):
+  v1  input signature + param names only; batch dim traced FIXED at the
+      example input's shape.
+  v2  adds ``output_shape``/``output_dtype`` and ``dynamic_batch``: the
+      program is exported with a SYMBOLIC leading batch dim (jax.export
+      shape polymorphism) whenever the model permits, so one artifact
+      serves every request size — the enabler for ``mx.serving``'s
+      bucketed continuous batching.  v1 artifacts still load (the missing
+      fields default to fixed-batch semantics).
 """
 from __future__ import annotations
 
@@ -24,15 +34,34 @@ import os
 
 import numpy as _np
 
-__all__ = ["export_model", "load_model", "StableHLOPredictor"]
+__all__ = ["export_model", "load_model", "StableHLOPredictor",
+           "FORMAT_VERSION"]
+
+FORMAT_VERSION = 2
 
 
-def export_model(block, prefix, example_input, include_params=True):
+def _shape_signature(aval):
+    """JSON-safe shape: symbolic dims (batch polymorphism) become None."""
+    out = []
+    for d in aval.shape:
+        try:
+            out.append(int(d))
+        except Exception:  # noqa: BLE001 — symbolic dim (no constant value)
+            out.append(None)
+    return out
+
+
+def export_model(block, prefix, example_input, include_params=True,
+                 dynamic_batch=True):
     """Serialize a Gluon block's inference function to StableHLO.
 
     The exported program is a pure function ``f(params..., data)`` traced at
     the example input's shape/dtype; parameters ship alongside in an .npz.
-    Returns the list of written paths.
+    With ``dynamic_batch`` (default) the leading data dim is exported as a
+    SYMBOLIC dimension so the artifact accepts any batch size — models whose
+    lowering constrains the batch dim (batch-dependent reshapes) fall back
+    to the fixed-shape v1 tracing semantics, recorded as
+    ``meta["dynamic_batch"] = false``.  Returns the list of written paths.
     """
     import jax
     from jax import export as jexport
@@ -60,11 +89,24 @@ def export_model(block, prefix, example_input, include_params=True):
         return out
 
     jitted = jax.jit(infer)
-    spec = (
-        tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in values),
-        jax.ShapeDtypeStruct(data.shape, data.dtype),
-    )
-    exp = jexport.export(jitted)(*spec)
+    param_spec = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for v in values)
+    exp = None
+    exported_dynamic = False
+    if dynamic_batch and len(data.shape) >= 1:
+        try:
+            b = jexport.symbolic_shape("b")[0]
+            spec = (param_spec,
+                    jax.ShapeDtypeStruct((b,) + tuple(data.shape[1:]),
+                                         data.dtype))
+            exp = jexport.export(jitted)(*spec)
+            exported_dynamic = True
+        except Exception:  # noqa: BLE001 — model constrains the batch dim
+            exp = None
+    if exp is None:
+        spec = (param_spec, jax.ShapeDtypeStruct(data.shape, data.dtype))
+        exp = jexport.export(jitted)(*spec)
+    out_aval = exp.out_avals[0]
     paths = []
     hlo_path = prefix + "-model.stablehlo"
     with open(hlo_path, "wb") as f:
@@ -74,7 +116,10 @@ def export_model(block, prefix, example_input, include_params=True):
         "param_names": names,
         "input_shape": list(data.shape),
         "input_dtype": str(data.dtype),
-        "format_version": 1,
+        "output_shape": _shape_signature(out_aval),
+        "output_dtype": str(out_aval.dtype),
+        "dynamic_batch": exported_dynamic,
+        "format_version": FORMAT_VERSION,
     }
     meta_path = prefix + "-meta.json"
     with open(meta_path, "w") as f:
@@ -90,31 +135,86 @@ def export_model(block, prefix, example_input, include_params=True):
 
 class StableHLOPredictor:
     """Reloaded inference program (the MXPredCreate/MXPredForward analog:
-    include/mxnet/c_predict_api.h)."""
+    include/mxnet/c_predict_api.h).
+
+    Parameters are staged DEVICE-RESIDENT once at construction (through
+    ``io.ensure_staged``, so the one-time upload is visible on the
+    ``io.h2d_sync`` counters) and reused by every ``predict`` — per-call
+    param re-upload was the PR-5-era bug this fixes.  The call itself goes
+    through one cached ``jax.jit`` wrapper, so repeated predicts at the
+    same request shape replay a compiled program instead of re-tracing.
+    """
 
     def __init__(self, prefix):
+        import jax
         from jax import export as jexport
+        from . import io as _io
         with open(prefix + "-model.stablehlo", "rb") as f:
             self._exported = jexport.deserialize(f.read())
         with open(prefix + "-meta.json") as f:
             self.meta = json.load(f)
+        self.format_version = int(self.meta.get("format_version", 1))
+        self.dynamic_batch = bool(self.meta.get("dynamic_batch", False))
         params_path = prefix + "-params.npz"
         self._params = None
         if os.path.exists(params_path):
             loaded = _np.load(params_path)
-            self._params = tuple(loaded[n]
-                                 for n in self.meta["param_names"])
+            # one-time H2D: params live on device for the predictor's life
+            self._params = tuple(
+                _io.ensure_staged(loaded[n], source="deploy")
+                for n in self.meta["param_names"])
+        exported = self._exported
+        self._call = jax.jit(lambda ps, x: exported.call(ps, x))
+
+    def _validate_input(self, x):
+        """Shape/dtype check against the exported signature — a clear
+        ValueError instead of an XLA shape-mismatch stack."""
+        want_shape = self.meta.get("input_shape")
+        want_dtype = self.meta.get("input_dtype")
+        if want_shape is None:
+            return
+        got = tuple(int(s) for s in x.shape)
+        want = tuple(want_shape)
+        if len(got) != len(want):
+            raise ValueError(
+                "input rank mismatch: exported signature is %s (%d dims), "
+                "got shape %s" % (self.signature(), len(want), got))
+        trailing_ok = got[1:] == want[1:]
+        batch_ok = self.dynamic_batch or got[0] == want[0]
+        if not (trailing_ok and batch_ok):
+            raise ValueError(
+                "input shape %s does not match the exported signature %s"
+                % (got, self.signature()))
+        if want_dtype is not None and str(x.dtype) != want_dtype:
+            raise ValueError(
+                "input dtype %s does not match the exported dtype %s"
+                % (x.dtype, want_dtype))
+
+    def signature(self):
+        """Human-readable input signature, e.g. ``(N, 3, 224, 224)`` for a
+        dynamic-batch artifact or ``(8, 3, 224, 224)`` for a fixed one."""
+        shape = self.meta.get("input_shape") or ()
+        dims = ["N" if self.dynamic_batch and i == 0 else str(d)
+                for i, d in enumerate(shape)]
+        return "(" + ", ".join(dims) + ")"
 
     def predict(self, data, params=None):
         """Run inference; returns a host numpy array."""
         import jax.numpy as jnp
         from .ndarray.ndarray import NDArray
-        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        ps = params if params is not None else self._params
+        # validate BEFORE jnp.asarray: the backend would silently downcast
+        # a float64 host array to float32, hiding the dtype mismatch
+        raw = data._data if isinstance(data, NDArray) else _np.asarray(data)
+        self._validate_input(raw)
+        x = raw if isinstance(data, NDArray) else jnp.asarray(raw)
+        if params is not None:
+            ps = tuple(jnp.asarray(p) for p in params)
+        else:
+            ps = self._params
         if ps is None:
             raise ValueError("no params: artifact exported with "
                              "include_params=False and none were given")
-        out = self._exported.call(tuple(jnp.asarray(p) for p in ps), x)
+        out = self._call(ps, x)
         return _np.asarray(out)
 
     def forward(self, data):

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "ResizeIter", "PrefetchingIter", "DevicePrefetcher", "MNISTIter",
            "LibSVMIter", "ImageDetRecordIter", "ImageRecordIter",
-           "ensure_staged", "is_staged"]
+           "ensure_staged", "is_staged", "bucket_sizes", "pick_bucket",
+           "pad_rows_to"]
 
 _LOG = logging.getLogger("mxnet_tpu.io")
 
@@ -412,12 +413,17 @@ def ensure_staged(x, placement=None, source="step"):
     return _stage_put(x, sharding, source)
 
 
-def _bucket_sizes(policy, batch_size):
+def bucket_sizes(policy, batch_size):
     """Row-count buckets a ragged batch may be padded up to.
 
     ``"full"``  → one bucket: ``batch_size`` (zero recompiles per epoch),
     ``"pow2"``  → powers of two up to ``batch_size`` (≤ log2 N shapes),
     ``"off"``   → no padding (each ragged tail compiles a fresh program).
+
+    Shared pad-bucket policy: ``DevicePrefetcher`` buckets training batches
+    with it and ``mx.serving`` buckets coalesced inference requests with it,
+    so both sides of the framework agree on which shapes ever reach the
+    compiler.
     """
     policy = str(policy or "off").strip().lower()
     if policy in ("off", "none", ""):
@@ -433,6 +439,29 @@ def _bucket_sizes(policy, batch_size):
         return tuple(sizes)
     raise ValueError(
         "io.pad_buckets must be 'off', 'full' or 'pow2', got %r" % (policy,))
+
+
+_bucket_sizes = bucket_sizes  # PR-5 internal name, kept for callers/tests
+
+
+def pick_bucket(buckets, n):
+    """Smallest bucket that fits ``n`` rows, or None when no bucket does
+    (the caller keeps the natural shape)."""
+    return next((b for b in buckets if b >= n), None)
+
+
+def pad_rows_to(arr, target):
+    """Wrap-pad ``arr`` along axis 0 up to ``target`` rows — the
+    NDArrayIter roll-over semantics, so fill rows hold real (repeated)
+    samples and stay in-distribution for unmasked consumers.  Accepts
+    numpy, jax arrays or NDArray; returns the same flavor it was given
+    (host numpy stays host-side)."""
+    raw = arr._data if isinstance(arr, NDArray) else arr
+    host = _np.asarray(raw)
+    n = host.shape[0]
+    idx = _np.arange(target - n) % max(n, 1)
+    out = _np.concatenate([host, host[idx]], axis=0)
+    return _wrap(jnp.asarray(out)) if isinstance(arr, NDArray) else out
 
 
 def _shutdown_prefetch_worker(thread, stop_event, q, deadline_s=5.0):
@@ -613,15 +642,9 @@ class DevicePrefetcher(DataIter):
         return None
 
     def _pad_rows(self, arr, target):
-        """Wrap-pad ``arr`` along axis 0 up to ``target`` rows — the
-        NDArrayIter roll-over semantics, so fill rows hold real (repeated)
-        samples and stay in-distribution for unmasked consumers."""
-        raw = arr._data if isinstance(arr, NDArray) else arr
-        host = _np.asarray(raw)
-        n = host.shape[0]
-        idx = _np.arange(target - n) % max(n, 1)
-        out = _np.concatenate([host, host[idx]], axis=0)
-        return _wrap(jnp.asarray(out)) if isinstance(arr, NDArray) else out
+        """Instance seam over the shared :func:`pad_rows_to` (tests
+        monkeypatch it to exercise the fallback path)."""
+        return pad_rows_to(arr, target)
 
     def _pad_to_bucket(self, batch):
         if not self._buckets:
@@ -629,7 +652,7 @@ class DevicePrefetcher(DataIter):
         n = self._rows(batch)
         if n is None:
             return batch
-        target = next((b for b in self._buckets if b >= n), None)
+        target = pick_bucket(self._buckets, n)
         if target is None or target == n:
             return batch
         if not all(isinstance(a._data if type(a) is NDArray else a,

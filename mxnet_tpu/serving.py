@@ -1,0 +1,527 @@
+"""``mx.serving`` — continuous-batching inference over the StableHLO
+export path.
+
+Reference deployment story: the C predict API served one process-local
+model per handle (include/mxnet/c_predict_api.h) and TensorRT subgraph
+serving owned the batched GPU path (SURVEY §2, §5).  The TPU-native analog
+is a REQUEST QUEUE in front of the ``mx.deploy`` artifact: concurrent
+``submit()`` calls coalesce into batches padded up to the shared
+``io.pad_buckets`` bucket set, so a SMALL, FIXED family of AOT-compiled
+programs (one per ``(model, bucket)``) serves every request size — the
+same pad-bucket policy the PR-5 input pipeline uses to keep training
+compiles flat now keeps serving compiles flat.
+
+Architecture (one background batcher thread per :class:`Server`):
+
+  submit(name, x) ──► per-server FIFO ──► batcher loop:
+                                            take first request
+                                            coalesce same-model requests
+                                              until rows == max_batch or
+                                              max_queue_delay_ms elapses
+                                            concat + wrap-pad → bucket
+                                            AOT program(params, batch)
+                                            scatter rows → caller futures
+
+Key properties:
+
+  * **Bitwise-stable batching** — each output row of a bucketed dispatch
+    equals the row the unbatched ``StableHLOPredictor.predict`` produces
+    (row-independent inference math; ``tools/check_serving.py`` proves it
+    under concurrent ragged traffic).
+  * **Zero steady-state compiles** — every ``(model, bucket)`` program is
+    compiled eagerly at :meth:`Server.start`; ragged request sizes never
+    reach the compiler.  ``serving.compile_cache_dir`` wires jax's
+    persistent compilation cache so a RESTARTED server skips even those
+    (near-zero cold start).
+  * **Device-resident params** — uploaded once at ``register()`` (by the
+    underlying :class:`~mxnet_tpu.deploy.StableHLOPredictor`), never per
+    request.
+  * **Multi-model** — a bounded LRU table of registered models; the least
+    recently used model (programs + device params) is evicted when
+    ``max_models`` is exceeded.
+  * **Telemetry** — ``serving.requests`` / ``serving.batch_dispatches`` /
+    ``serving.compiles`` counters, ``serving.queue_delay_ms`` /
+    ``serving.batch_fill`` / ``serving.dispatch_ms`` /
+    ``serving.request_ms`` timer histograms (p99 end-to-end latency =
+    ``timer("serving.request_ms").stats()["p99"]``), one ``serving`` JSONL
+    record per dispatch on the telemetry sink, and ``serving.submit`` /
+    ``serving.dispatch`` spans with cross-thread parentage (the batcher
+    runs under ``tracing.wrap_context``, the ``io.prefetch`` pattern).
+
+Knobs (config.py): ``serving.max_batch`` (MXNET_TPU_SERVING_MAX_BATCH),
+``serving.max_queue_delay_ms`` (MXNET_TPU_SERVING_MAX_QUEUE_DELAY_MS),
+``serving.compile_cache_dir`` (MXNET_TPU_SERVING_COMPILE_CACHE_DIR); the
+bucket POLICY is the shared ``io.pad_buckets`` knob.  docs/SERVING.md has
+the full architecture note.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+import jax
+
+from . import config as _config
+from . import io as _io
+from . import telemetry as _telemetry
+
+__all__ = ["Server", "ServingError", "load_server"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving")
+
+
+class ServingError(RuntimeError):
+    """Raised for serving lifecycle errors (stopped server, evicted or
+    unknown model, oversized request on a fixed-batch artifact)."""
+
+
+class _Request:
+    """One caller request: host-side rows plus the future its output rows
+    resolve, stamped with the submit time for queue-delay accounting."""
+
+    __slots__ = ("model", "data", "rows", "future", "t_submit")
+
+    def __init__(self, model, data, future):
+        self.model = model
+        self.data = data
+        self.rows = int(data.shape[0])
+        self.future = future
+        self.t_submit = _time.perf_counter()
+
+
+class _ModelEntry:
+    """A registered model: reloaded artifact, device-resident params, and
+    the per-bucket AOT program table."""
+
+    __slots__ = ("name", "prefix", "predictor", "buckets", "programs",
+                 "item_shape", "in_dtype")
+
+    def __init__(self, name, prefix, predictor, buckets):
+        self.name = name
+        self.prefix = prefix
+        self.predictor = predictor
+        self.buckets = tuple(buckets)
+        self.programs = {}
+        shape = predictor.meta.get("input_shape") or []
+        self.item_shape = tuple(int(s) for s in shape[1:])
+        self.in_dtype = _np.dtype(predictor.meta.get("input_dtype",
+                                                     "float32"))
+
+    @property
+    def capacity(self):
+        return self.buckets[-1]
+
+
+_CACHE_DIR_APPLIED = [None]
+
+
+def _configure_compile_cache():
+    """Wire jax's persistent compilation cache from the
+    ``serving.compile_cache_dir`` knob (idempotent).  With the cache dir
+    set, a restarted server's eager ``start()`` compiles hit disk instead
+    of XLA — the near-zero cold-start contract."""
+    cache_dir = (_config.get("serving.compile_cache_dir") or "").strip()
+    if not cache_dir:
+        return False
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # serving programs are small and fast-compiling on CPU; without these
+    # floors the cache would skip exactly the programs we want to persist
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    if _CACHE_DIR_APPLIED[0] != cache_dir:
+        # jax initializes its cache object on the FIRST compile of the
+        # process; a dir set after that (the common case — params staged
+        # and models warmed before start()) is silently ignored until the
+        # cache is re-initialized
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — older jax: dir applies lazily
+            pass
+        _CACHE_DIR_APPLIED[0] = cache_dir
+    return True
+
+
+class Server:
+    """Continuous-batching inference server over ``mx.deploy`` artifacts.
+
+    Usage::
+
+        srv = mx.serving.Server(max_batch=32, max_queue_delay_ms=2.0)
+        srv.register("resnet", "/models/resnet50")   # params → device
+        srv.start()                                  # AOT-compile buckets
+        fut = srv.submit("resnet", batch_of_images)  # any request size
+        probs = fut.result()                         # host numpy rows
+        srv.stop()                                   # graceful drain
+
+    ``submit`` is thread-safe; requests from any number of caller threads
+    coalesce into bucketed batches on the single batcher thread.  Requests
+    larger than the biggest bucket are transparently split into chunks and
+    their outputs re-concatenated.  ``Server`` is also a context manager
+    (``with Server() as srv: ...`` starts and drains it).
+    """
+
+    def __init__(self, max_batch=None, max_queue_delay_ms=None,
+                 buckets=None, max_models=8):
+        if max_batch is None:
+            max_batch = _config.get("serving.max_batch")
+        if max_queue_delay_ms is None:
+            max_queue_delay_ms = _config.get("serving.max_queue_delay_ms")
+        if buckets is None:
+            buckets = _config.get("io.pad_buckets")
+        self.max_batch = int(max_batch)
+        self.max_queue_delay_ms = float(max_queue_delay_ms)
+        self._bucket_policy = buckets
+        self.max_models = int(max_models)
+        self._models = OrderedDict()     # name -> _ModelEntry (LRU order)
+        self._pending = deque()
+        self._cond = threading.Condition()
+        self._thread = None
+        self._started = False
+        self._stopping = False
+
+    # ------------------------------------------------------------ models
+    def _policy_buckets(self, cap):
+        sizes = _io.bucket_sizes(self._bucket_policy, cap)
+        # serving must always have at least one compiled shape; policy
+        # 'off' (natural shapes) degenerates to the single full bucket
+        return sizes or (cap,)
+
+    def register(self, name, prefix):
+        """Load the ``mx.deploy`` artifact at ``prefix`` under ``name``:
+        params go device-resident now; bucket programs compile now if the
+        server is already started (else at :meth:`start`).  Re-registering
+        a name replaces the entry.  The table is LRU-bounded at
+        ``max_models`` — registering past it evicts the least recently
+        used model (its programs and device params become collectable)."""
+        from . import deploy as _deploy
+        predictor = _deploy.StableHLOPredictor(prefix)
+        if predictor._params is None:
+            raise ServingError(
+                "model %r: artifact %r was exported with "
+                "include_params=False; serving needs shipped params"
+                % (name, prefix))
+        if predictor.dynamic_batch:
+            buckets = self._policy_buckets(self.max_batch)
+        else:
+            # fixed-shape artifact (v1, or a model whose lowering
+            # constrains the batch dim): its one exported batch size IS
+            # the bucket set
+            fixed = int(predictor.meta["input_shape"][0])
+            buckets = (fixed,)
+        entry = _ModelEntry(name, prefix, predictor, buckets)
+        with self._cond:
+            self._models.pop(name, None)
+            self._models[name] = entry
+            evicted = []
+            while len(self._models) > self.max_models:
+                victim, _ = self._models.popitem(last=False)
+                evicted.append(victim)
+        for victim in evicted:
+            _telemetry.counter("serving.models_evicted").inc()
+            _LOG.info("serving: evicted LRU model %r (max_models=%d)",
+                      victim, self.max_models)
+        if self._started:
+            self._compile_entry(entry)
+        return entry
+
+    def unregister(self, name):
+        with self._cond:
+            self._models.pop(name, None)
+
+    def models(self):
+        """Registered model names, least recently used first."""
+        with self._cond:
+            return list(self._models)
+
+    def _entry(self, name):
+        with self._cond:
+            entry = self._models.get(name)
+            if entry is not None:
+                self._models.move_to_end(name)  # LRU touch
+        if entry is None:
+            raise ServingError(
+                "unknown model %r (registered: %s — evicted models must "
+                "be register()ed again)" % (name, self.models()))
+        return entry
+
+    # ----------------------------------------------------------- compile
+    def _compile_entry(self, entry):
+        for bucket in entry.buckets:
+            if bucket not in entry.programs:
+                entry.programs[bucket] = self._compile(entry, bucket)
+
+    def _compile(self, entry, bucket):
+        from . import tracing as _tracing
+        exported = entry.predictor._exported
+        params = entry.predictor._params
+        fn = jax.jit(lambda ps, x: exported.call(ps, x))
+        pspec = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                      for p in params)
+        xspec = jax.ShapeDtypeStruct((bucket,) + entry.item_shape,
+                                     entry.in_dtype)
+        t0 = _time.perf_counter()
+        with _tracing.span("serving.compile", cat="serving",
+                           model=entry.name, bucket=bucket):
+            program = fn.lower(pspec, xspec).compile()
+        _telemetry.counter("serving.compiles").inc()
+        _telemetry.timer("serving.compile_ms").observe(
+            (_time.perf_counter() - t0) * 1e3)
+        return program
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        """Compile every registered ``(model, bucket)`` program eagerly
+        (restart-warm via the persistent compile cache when
+        ``serving.compile_cache_dir`` is set) and start the batcher
+        thread.  Idempotent while running; restartable after ``stop``."""
+        from . import tracing as _tracing
+        if self._started:
+            return self
+        _configure_compile_cache()
+        with self._cond:
+            entries = list(self._models.values())
+        for entry in entries:
+            self._compile_entry(entry)
+        self._stopping = False
+        self._started = True
+        # wrap_context: dispatch spans keep the starter's trace parentage
+        # across the thread hop (the io.prefetch pattern)
+        self._thread = threading.Thread(
+            target=_tracing.wrap_context(self._loop), daemon=True,
+            name="mx-serving-batcher")
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout_s=30.0):
+        """Stop the server.  New submits fail immediately; with ``drain``
+        (default) every already-queued request is dispatched before the
+        batcher exits, so no accepted future is left unresolved."""
+        with self._cond:
+            if not self._started:
+                return
+            self._stopping = True
+            if not drain:
+                abandoned = list(self._pending)
+                self._pending.clear()
+            else:
+                abandoned = []
+            self._cond.notify_all()
+        for req in abandoned:
+            req.future.set_exception(
+                ServingError("server stopped without drain"))
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+            if thread.is_alive():
+                _telemetry.counter("serving.stop_timeout").inc()
+                _LOG.warning("serving: batcher did not drain within %.1fs",
+                             timeout_s)
+        self._started = False
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------ submit
+    def _validate(self, entry, arr):
+        if arr.ndim != len(entry.item_shape) + 1:
+            raise ValueError(
+                "model %r: request rank mismatch — exported signature is "
+                "%s, got shape %s" % (entry.name,
+                                      entry.predictor.signature(),
+                                      tuple(arr.shape)))
+        if tuple(arr.shape[1:]) != entry.item_shape:
+            raise ValueError(
+                "model %r: request item shape %s does not match the "
+                "exported signature %s" % (entry.name, tuple(arr.shape),
+                                           entry.predictor.signature()))
+        if arr.dtype != entry.in_dtype:
+            raise ValueError(
+                "model %r: request dtype %s does not match the exported "
+                "dtype %s" % (entry.name, arr.dtype, entry.in_dtype))
+        if arr.shape[0] < 1:
+            raise ValueError("model %r: empty request" % (entry.name,))
+
+    def submit(self, name, data):
+        """Enqueue one request (any row count) for model ``name``; returns
+        a ``concurrent.futures.Future`` resolving to the host numpy output
+        rows for exactly the submitted rows (padding is invisible)."""
+        from . import tracing as _tracing
+        from .ndarray.ndarray import NDArray
+        with _tracing.span("serving.submit", cat="serving", model=name):
+            entry = self._entry(name)
+            arr = _np.asarray(data._data if isinstance(data, NDArray)
+                              else data)
+            self._validate(entry, arr)
+            _telemetry.counter("serving.requests").inc()
+            cap = entry.capacity
+            if arr.shape[0] <= cap:
+                return self._enqueue(_Request(name, arr, Future()))
+            # oversized request: split into cap-row chunks, re-concatenate
+            chunks = [arr[i:i + cap] for i in range(0, arr.shape[0], cap)]
+            _telemetry.counter("serving.request_chunks").inc(len(chunks))
+            futures = [self._enqueue(_Request(name, c, Future()))
+                       for c in chunks]
+            combined = Future()
+            remaining = [len(futures)]
+            lock = threading.Lock()
+
+            def _one_done(_f):
+                with lock:
+                    remaining[0] -= 1
+                    last = remaining[0] == 0
+                if not last or combined.done():
+                    return
+                try:
+                    combined.set_result(_np.concatenate(
+                        [f.result() for f in futures], axis=0))
+                except BaseException as exc:  # noqa: BLE001
+                    combined.set_exception(exc)
+
+            for f in futures:
+                f.add_done_callback(_one_done)
+            return combined
+
+    def _enqueue(self, req):
+        with self._cond:
+            if self._stopping or not self._started:
+                raise ServingError(
+                    "server is %s; submit() rejected"
+                    % ("stopping" if self._stopping else "not started"))
+            self._pending.append(req)
+            _telemetry.gauge("serving.pending").set(len(self._pending))
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, name, data, timeout=None):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(name, data).result(timeout)
+
+    # ----------------------------------------------------------- batcher
+    def _take_fitting(self, model, budget):
+        """Pop the first queued request for ``model`` with rows <=
+        ``budget`` (caller holds the condition lock)."""
+        for i, req in enumerate(self._pending):
+            if req.model == model and req.rows <= budget:
+                del self._pending[i]
+                return req
+        return None
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._pending:
+                    if self._stopping:
+                        return
+                    self._cond.wait(timeout=0.05)
+                first = self._pending.popleft()
+                _telemetry.gauge("serving.pending").set(len(self._pending))
+                entry = self._models.get(first.model)
+            if entry is None:  # model evicted with requests in flight
+                first.future.set_exception(ServingError(
+                    "model %r was evicted while queued" % (first.model,)))
+                continue
+            batch = [first]
+            rows = first.rows
+            cap = entry.capacity
+            deadline = first.t_submit + self.max_queue_delay_ms * 1e-3
+            while rows < cap:
+                with self._cond:
+                    req = self._take_fitting(first.model, cap - rows)
+                    if req is None:
+                        remaining = deadline - _time.perf_counter()
+                        if remaining <= 0 or self._stopping:
+                            break
+                        self._cond.wait(timeout=min(remaining, 0.005))
+                        continue
+                if req is not None:
+                    batch.append(req)
+                    rows += req.rows
+            self._dispatch(entry, batch, rows)
+
+    def _dispatch(self, entry, batch, rows):
+        from . import tracing as _tracing
+        t0 = _time.perf_counter()
+        bucket = _io.pick_bucket(entry.buckets, rows) or entry.capacity
+        for req in batch:
+            _telemetry.timer("serving.queue_delay_ms").observe(
+                (t0 - req.t_submit) * 1e3)
+        try:
+            cat = batch[0].data if len(batch) == 1 else \
+                _np.concatenate([req.data for req in batch], axis=0)
+            padded = _io.pad_rows_to(cat, bucket) if bucket > rows else cat
+            with _tracing.span("serving.dispatch", cat="serving",
+                               model=entry.name, requests=len(batch),
+                               rows=rows, bucket=bucket):
+                program = entry.programs.get(bucket)
+                if program is None:
+                    # a bucket registered after start(), or a fixed-batch
+                    # artifact's single shape — compile once, then cached
+                    program = entry.programs[bucket] = \
+                        self._compile(entry, bucket)
+                out = program(entry.predictor._params, padded)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            host = _np.asarray(out)
+        except BaseException as exc:  # noqa: BLE001 — fail the batch's
+            # futures, never the batcher thread itself
+            _telemetry.counter("serving.dispatch_errors").inc()
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            return
+        t1 = _time.perf_counter()
+        ofs = 0
+        for req in batch:
+            req.future.set_result(host[ofs:ofs + req.rows])
+            ofs += req.rows
+            _telemetry.timer("serving.request_ms").observe(
+                (t1 - req.t_submit) * 1e3)
+        _telemetry.counter("serving.batch_dispatches").inc()
+        _telemetry.timer("serving.batch_fill").observe(rows / bucket)
+        _telemetry.timer("serving.dispatch_ms").observe((t1 - t0) * 1e3)
+        # one JSONL record per dispatch (no-op when the sink is off);
+        # tools/telemetry_report.py folds these into the serving table and
+        # the queue-delay anomaly check
+        if _telemetry.enabled():
+            _telemetry.log_event(
+                "serving", model=entry.name, requests=len(batch),
+                rows=rows, bucket=bucket,
+                fill=round(rows / bucket, 4),
+                queue_delay_ms=round(max(
+                    (t0 - req.t_submit) * 1e3 for req in batch), 4),
+                wall_ms=round((t1 - t0) * 1e3, 4),
+                budget_ms=self.max_queue_delay_ms)
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        """Serving-slice snapshot of the telemetry registry (counters and
+        timer histograms whose names start with ``serving.``)."""
+        snap = _telemetry.snapshot()
+        return {
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("serving.")},
+            "timers": {k: v for k, v in snap["timers"].items()
+                       if k.startswith("serving.")},
+            "models": self.models(),
+        }
+
+
+def load_server(prefixes, **kwargs):
+    """Convenience: build, register and start a server from
+    ``{name: prefix}``."""
+    srv = Server(**kwargs)
+    for name, prefix in dict(prefixes).items():
+        srv.register(name, prefix)
+    return srv.start()

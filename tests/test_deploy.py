@@ -4,11 +4,13 @@ include/mxnet/c_predict_api.h + contrib/onnx export).
 The headline contract (VERDICT r2 #9): export ResNet-50, reload in a FRESH
 PROCESS, bitwise-equal inference.
 """
+import json
 import os
 import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import deploy, gluon
@@ -94,3 +96,81 @@ def test_export_without_params_and_external_params(tmp_path):
     params = [np.asarray(v) for v in fn.init_values().values()]
     got = pred.predict(x, params=params)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # the ValueError path: no shipped params and none supplied
+    with pytest.raises(ValueError, match="include_params=False"):
+        pred.predict(x)
+
+
+def _export_small(tmp_path, name="m", batch=2, **kwargs):
+    net = _small_net()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(5).randn(batch, 3, 8, 8)
+                    .astype(np.float32))
+    prefix = str(tmp_path / name)
+    deploy.export_model(net, prefix, x, **kwargs)
+    return prefix, x
+
+
+def test_params_staged_once_no_per_call_h2d(tmp_path):
+    """Regression for the PR-5-era bug: predict() re-uploaded every param
+    per call.  Params go device-resident in __init__; repeated predicts do
+    ZERO further caller-thread H2D (the io.h2d_sync counter stays flat)."""
+    from mxnet_tpu import telemetry
+    prefix, x = _export_small(tmp_path)
+    before_init = telemetry.counter("io.h2d_sync").value
+    pred = deploy.load_model(prefix)
+    staged = telemetry.counter("io.h2d_sync").value - before_init
+    assert staged == len(pred.meta["param_names"])  # the one-time upload
+    first = pred.predict(x)
+    flat0 = telemetry.counter("io.h2d_sync").value
+    for _ in range(3):
+        np.testing.assert_array_equal(pred.predict(x), first)
+    assert telemetry.counter("io.h2d_sync").value == flat0, \
+        "predict() re-staged params per call"
+
+
+def test_meta_v2_fields_and_dynamic_batch(tmp_path):
+    prefix, x = _export_small(tmp_path)
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    assert meta["format_version"] == deploy.FORMAT_VERSION == 2
+    assert meta["dynamic_batch"] is True
+    assert meta["output_shape"] == [None, 4]  # symbolic batch dim
+    assert meta["output_dtype"] == "float32"
+    pred = deploy.load_model(prefix)
+    assert pred.signature() == "(N, 3, 8, 8)"
+    # dynamic artifact accepts any batch size
+    out = pred.predict(np.random.RandomState(6)
+                       .randn(5, 3, 8, 8).astype(np.float32))
+    assert out.shape == (5, 4)
+
+
+def test_v1_meta_loads_with_fixed_batch_semantics(tmp_path):
+    """A v1 artifact (no output fields, no dynamic_batch, no version) still
+    loads; the missing fields default to fixed-batch v1 semantics."""
+    prefix, x = _export_small(tmp_path, dynamic_batch=False)
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    v1 = {k: meta[k] for k in ("param_names", "input_shape", "input_dtype")}
+    with open(prefix + "-meta.json", "w") as f:
+        json.dump(v1, f)
+    pred = deploy.load_model(prefix)
+    assert pred.format_version == 1
+    assert not pred.dynamic_batch
+    assert pred.signature() == "(2, 3, 8, 8)"
+    assert pred.predict(x).shape == (2, 4)
+
+
+def test_predict_validates_shape_and_dtype(tmp_path):
+    prefix, x = _export_small(tmp_path, dynamic_batch=False)
+    pred = deploy.load_model(prefix)
+    with pytest.raises(ValueError, match="rank mismatch"):
+        pred.predict(np.zeros((2, 3, 8), np.float32))
+    with pytest.raises(ValueError, match="does not match the exported "
+                                         "signature"):
+        pred.predict(np.zeros((2, 3, 9, 9), np.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        pred.predict(np.zeros((2, 3, 8, 8), np.float64))
+    # fixed-batch artifact also pins the batch dim
+    with pytest.raises(ValueError, match="signature"):
+        pred.predict(np.zeros((3, 3, 8, 8), np.float32))

@@ -24,6 +24,16 @@ device memory.  Anomaly flags:
     synchronous H2D (host-side prefetch) are their normal mode, not
     flagged.
 
+``serving`` records (one per mx.serving batch dispatch) get their own
+per-model table — dispatches, requests, rows, mean batch fill, queue-delay
+and dispatch-wall p50/p99, buckets hit — plus the anomaly:
+
+  * queue-delay blowup — p99 queue delay > 3x the configured
+    max_queue_delay_ms budget (and over the latency floor) across >= 10
+    dispatches: the batcher can't keep up with offered load (dispatch
+    wall time exceeds the arrival rate) so requests queue far past the
+    batching window.
+
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # tables + flags
   python tools/telemetry_report.py RUN.jsonl --json   # machine-readable
@@ -40,6 +50,7 @@ P99_P50_RATIO = 3.0
 LATENCY_FLOOR_MS = 10.0  # sub-10ms tails are scheduler noise, not stalls
 THROUGHPUT_DROP = 0.7
 MIN_STEPS_FOR_FLAGS = 10
+QUEUE_DELAY_RATIO = 3.0  # serving p99 queue delay vs the configured budget
 
 
 def load_records(path):
@@ -74,13 +85,70 @@ def _pct(sorted_vals, p):
     return sorted_vals[i]
 
 
+def _summarize_serving(serving_recs, anomalies):
+    """Per-model table over ``serving`` dispatch records, appending the
+    queue-delay anomaly to ``anomalies`` in place."""
+    by_model = {}
+    for r in serving_recs:
+        by_model.setdefault(r.get("model", "?"), []).append(r)
+    tables = {}
+    for model in sorted(by_model):
+        recs = by_model[model]
+        delays = sorted(float(r["queue_delay_ms"]) for r in recs
+                        if isinstance(r.get("queue_delay_ms"),
+                                      (int, float)))
+        walls = sorted(float(r["wall_ms"]) for r in recs
+                       if isinstance(r.get("wall_ms"), (int, float)))
+        fills = [float(r["fill"]) for r in recs
+                 if isinstance(r.get("fill"), (int, float))]
+        requests = sum(int(r.get("requests") or 0) for r in recs)
+        rows = sum(int(r.get("rows") or 0) for r in recs)
+        buckets = sorted({int(r["bucket"]) for r in recs
+                          if isinstance(r.get("bucket"), int)})
+        budgets = [float(r["budget_ms"]) for r in recs
+                   if isinstance(r.get("budget_ms"), (int, float))]
+        qd_p50 = _pct(delays, 50)
+        qd_p99 = _pct(delays, 99)
+        tables[model] = {
+            "dispatches": len(recs),
+            "requests": requests,
+            "rows": rows,
+            "fill_mean": round(sum(fills) / len(fills), 3)
+            if fills else None,
+            "queue_delay_ms_p50": round(qd_p50, 3)
+            if qd_p50 is not None else None,
+            "queue_delay_ms_p99": round(qd_p99, 3)
+            if qd_p99 is not None else None,
+            "wall_ms_p50": round(_pct(walls, 50), 3) if walls else None,
+            "wall_ms_p99": round(_pct(walls, 99), 3) if walls else None,
+            "buckets": buckets,
+        }
+        # queue delays should sit near the batching budget; a p99 far past
+        # it means arrivals outpace dispatch and the queue is backing up.
+        # Without a recorded budget, a fat p99/p50 tail is the fallback.
+        budget = max(budgets) if budgets else 0.0
+        baseline = budget if budget > 0 else (qd_p50 or 0.0)
+        if (len(delays) >= MIN_STEPS_FOR_FLAGS and qd_p99 is not None and
+                qd_p99 >= LATENCY_FLOOR_MS and baseline > 0 and
+                qd_p99 > QUEUE_DELAY_RATIO * baseline):
+            anomalies.append({
+                "kind": "queue_delay_blowup", "source": model,
+                "detail": "serving p99 queue delay %.3fms vs %.1fms "
+                          "batching budget (> %.1fx): batcher is not "
+                          "keeping up with offered load"
+                          % (qd_p99, budget, QUEUE_DELAY_RATIO)})
+    return tables
+
+
 def summarize(records):
-    """Reduce parsed records to {"sources": {name: table}, "anomalies":
-    [...], "monitor_events": int, "other_events": int}.  Used by the CLI
-    and by tools/check_telemetry.py's no-anomalies assertion."""
+    """Reduce parsed records to {"sources": {name: table}, "serving":
+    {model: table}, "anomalies": [...], "monitor_events": int,
+    "other_events": int}.  Used by the CLI and by
+    tools/check_telemetry.py's no-anomalies assertion."""
     steps = [r for r in records if r.get("event") == "step"]
+    serving_recs = [r for r in records if r.get("event") == "serving"]
     monitor_events = sum(1 for r in records if r.get("event") == "monitor")
-    other = len(records) - len(steps) - monitor_events
+    other = len(records) - len(steps) - len(serving_recs) - monitor_events
 
     sources = {}
     anomalies = []
@@ -173,7 +241,8 @@ def summarize(records):
                               "%.1f (< %d%%)" % (second, first,
                                                  THROUGHPUT_DROP * 100)})
 
-    return {"sources": sources, "anomalies": anomalies,
+    serving = _summarize_serving(serving_recs, anomalies)
+    return {"sources": sources, "serving": serving, "anomalies": anomalies,
             "monitor_events": monitor_events, "other_events": other}
 
 
@@ -201,6 +270,23 @@ def render(summary, bad_lines=0):
                      % (path_str, t.get("sync_h2d", 0)))
     if not summary["sources"]:
         lines.append("(no step records)")
+    serving = summary.get("serving") or {}
+    if serving:
+        lines.append("")
+        shdr = ("%-10s %9s %9s %7s %6s %10s %10s %9s %9s %s"
+                % ("model", "dispatch", "requests", "rows", "fill",
+                   "qd_p50ms", "qd_p99ms", "w_p50ms", "w_p99ms",
+                   "buckets"))
+        lines.append(shdr)
+        lines.append("-" * len(shdr))
+        for model, t in serving.items():
+            lines.append("%-10s %9d %9d %7d %6s %10s %10s %9s %9s %s"
+                         % (model, t["dispatches"], t["requests"],
+                            t["rows"], _fmt(t["fill_mean"]),
+                            _fmt(t["queue_delay_ms_p50"]),
+                            _fmt(t["queue_delay_ms_p99"]),
+                            _fmt(t["wall_ms_p50"]), _fmt(t["wall_ms_p99"]),
+                            ",".join(str(b) for b in t["buckets"])))
     if summary["monitor_events"]:
         lines.append("monitor events: %d" % summary["monitor_events"])
     if summary["other_events"]:
