@@ -296,7 +296,10 @@ register_knob(
     "'io:0.05,ckpt_write:1@step=3,nan:1@step=7' — kind:probability per "
     "opportunity, or kind:count@step=N (1-based). Kinds: io (batch "
     "fetch), kvstore (push/pull), ckpt_write (inside atomic_write), nan "
-    "(poison a training batch). Empty (default) disables the harness.")
+    "(poison a training batch), serving_dispatch (fail an mx.serving "
+    "batch dispatch — feeds the circuit breaker), serving_slow (delay a "
+    "serving dispatch ~250ms — stall/deadline/shed testing). Empty "
+    "(default) disables the harness.")
 register_knob(
     "resilience.fault_seed", "MXNET_TPU_FAULT_SEED", int, 0,
     "seed for the fault-injection RNGs and retry jitter; two runs with "
@@ -420,6 +423,36 @@ register_knob(
     "Server.start(): bucket programs compiled on a previous run reload "
     "from disk for near-zero cold start. Empty (default) leaves the "
     "process-level jax cache settings untouched.")
+register_knob(
+    "serving.max_pending", "MXNET_TPU_SERVING_MAX_PENDING", int, 1024,
+    "mx.serving admission bound: submit() past this many queued requests "
+    "fails fast with ServerOverloadedError (retryable — it subclasses "
+    "OSError so resilience.call_with_retry backs off on it) instead of "
+    "queuing unboundedly; shed load counts in serving.shed_requests. "
+    "<= 0 disables the bound (PR-6 behavior).")
+register_knob(
+    "serving.default_deadline_ms", "MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS",
+    float, 0.0,
+    "default per-request deadline for mx.serving submit()/predict() in "
+    "milliseconds (overridable per call via submit(deadline_ms=...)): a "
+    "request still queued past its deadline completes with "
+    "DeadlineExceededError at batch-formation time and is never "
+    "dispatched — no compute is spent on an answer nobody is waiting "
+    "for (serving.deadline_exceeded counts them). 0 (default) = no "
+    "deadline.")
+register_knob(
+    "serving.breaker_threshold", "MXNET_TPU_SERVING_BREAKER_THRESHOLD",
+    int, 5,
+    "consecutive dispatch failures that open one model's mx.serving "
+    "circuit breaker: while open, submits for that model fail fast with "
+    "CircuitOpenError (other models keep serving); after the cooldown "
+    "the breaker goes half-open and probes with a single batch — success "
+    "closes it, failure re-opens. 0 disables the breaker.")
+register_knob(
+    "serving.breaker_cooldown_ms", "MXNET_TPU_SERVING_BREAKER_COOLDOWN_MS",
+    float, 1000.0,
+    "how long an OPEN mx.serving circuit breaker rejects before "
+    "transitioning to half-open and letting one probe batch through.")
 
 # bench / testing
 register_knob(

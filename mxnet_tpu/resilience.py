@@ -35,7 +35,12 @@ scattered defensive code:
     io:0.05,ckpt_write:1@step=3,nan:1@step=7`` (seeded by
     ``MXNET_TPU_FAULT_SEED``) makes every path above testable; the chaos
     smoke (tools/check_resilience.py) proves a faulted run converges
-    bitwise-identically to an unfaulted one.
+    bitwise-identically to an unfaulted one.  PR 7 extends the harness
+    into the serving plane: the ``serving_dispatch`` (fail a batch
+    dispatch — feeds the mx.serving circuit breaker) and ``serving_slow``
+    (delay a dispatch — shed/deadline/stall testing) kinds drive
+    tools/check_serving_chaos.py, and ``call_with_retry`` doubles as the
+    serving batcher's restart supervisor (kind ``serving_batcher``).
 
 Knobs live in config.py under ``resilience.*``; recovery semantics are
 documented in docs/RESILIENCE.md.

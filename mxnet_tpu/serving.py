@@ -11,28 +11,53 @@ programs (one per ``(model, bucket)``) serves every request size — the
 same pad-bucket policy the PR-5 input pipeline uses to keep training
 compiles flat now keeps serving compiles flat.
 
-Architecture (one background batcher thread per :class:`Server`):
+Architecture (one background batcher thread per :class:`Server`, run
+under a restart supervisor):
 
-  submit(name, x) ──► per-server FIFO ──► batcher loop:
-                                            take first request
-                                            coalesce same-model requests
-                                              until rows == max_batch or
-                                              max_queue_delay_ms elapses
-                                            concat + wrap-pad → bucket
-                                            AOT program(params, batch)
-                                            scatter rows → caller futures
+  submit(name, x) ──► admission check ──► per-server FIFO ──► batcher:
+                      (bounded queue,                          take first request
+                       breaker state)                          reap expired deadlines
+                                                               coalesce same-model requests
+                                                                 until rows == max_batch or
+                                                                 max_queue_delay_ms elapses
+                                                               concat + wrap-pad → bucket
+                                                               AOT program(params, batch)
+                                                               scatter rows → caller futures
 
 Key properties:
 
   * **Bitwise-stable batching** — each output row of a bucketed dispatch
     equals the row the unbatched ``StableHLOPredictor.predict`` produces
     (row-independent inference math; ``tools/check_serving.py`` proves it
-    under concurrent ragged traffic).
+    under concurrent ragged traffic, ``tools/check_serving_chaos.py``
+    under injected faults).
   * **Zero steady-state compiles** — every ``(model, bucket)`` program is
     compiled eagerly at :meth:`Server.start`; ragged request sizes never
     reach the compiler.  ``serving.compile_cache_dir`` wires jax's
     persistent compilation cache so a RESTARTED server skips even those
     (near-zero cold start).
+  * **Fail-fast under overload** — the pending queue is bounded
+    (``serving.max_pending``): a submit past the bound raises a retryable
+    :class:`ServerOverloadedError` instead of queuing until memory dies.
+  * **Deadlines** — ``submit(name, x, deadline_ms=...)`` (default from
+    ``serving.default_deadline_ms``): a request still queued past its
+    deadline completes with :class:`DeadlineExceededError` at
+    batch-formation time and is NEVER dispatched — no compute is spent on
+    answers nobody is waiting for.  ``predict(timeout=...)`` cancels its
+    queued request on timeout the same way.
+  * **Failure isolation** — a per-model circuit breaker opens after K
+    consecutive dispatch failures (``serving.breaker_threshold``),
+    fails that model's submits fast with :class:`CircuitOpenError` while
+    other models keep serving, then goes half-open after the cooldown and
+    probes with a single batch (success closes it, failure re-opens).
+  * **Batcher supervision** — an unexpected batcher crash fails every
+    pending future with the causal exception, bumps
+    ``serving.batcher_crashes``, and restarts the loop under the
+    ``mx.resilience`` retry budget/backoff; once the budget is exhausted
+    submits fail fast instead of hanging.  The PR-3 watchdog carries a
+    serving stall probe (``tracing.register_stall_probe``) that
+    flight-records open requests and breaker state whenever the queue is
+    non-empty but no dispatch completed within the watchdog interval.
   * **Device-resident params** — uploaded once at ``register()`` (by the
     underlying :class:`~mxnet_tpu.deploy.StableHLOPredictor`), never per
     request.
@@ -40,19 +65,33 @@ Key properties:
     recently used model (programs + device params) is evicted when
     ``max_models`` is exceeded.
   * **Telemetry** — ``serving.requests`` / ``serving.batch_dispatches`` /
-    ``serving.compiles`` counters, ``serving.queue_delay_ms`` /
-    ``serving.batch_fill`` / ``serving.dispatch_ms`` /
-    ``serving.request_ms`` timer histograms (p99 end-to-end latency =
-    ``timer("serving.request_ms").stats()["p99"]``), one ``serving`` JSONL
-    record per dispatch on the telemetry sink, and ``serving.submit`` /
-    ``serving.dispatch`` spans with cross-thread parentage (the batcher
-    runs under ``tracing.wrap_context``, the ``io.prefetch`` pattern).
+    ``serving.compiles`` / ``serving.shed_requests[.model]`` /
+    ``serving.deadline_exceeded[.model]`` / ``serving.breaker_open
+    [.model]`` / ``serving.batcher_crashes`` counters, a
+    ``serving.breaker_state.<model>`` gauge (0 closed / 1 half-open / 2
+    open), ``serving.queue_delay_ms`` / ``serving.batch_fill`` /
+    ``serving.dispatch_ms`` / ``serving.request_ms`` timer histograms,
+    one ``serving`` JSONL record per dispatch on the telemetry sink
+    (now carrying shed/deadline/breaker state for
+    ``tools/telemetry_report.py``'s overload anomaly), and
+    ``serving.submit`` / ``serving.dispatch`` spans with cross-thread
+    parentage (the batcher runs under ``tracing.wrap_context``, the
+    ``io.prefetch`` pattern).
+
+Deterministic chaos: the ``serving_dispatch`` (fail a dispatch) and
+``serving_slow`` (delay a dispatch) fault kinds plug into the shared
+``MXNET_TPU_FAULTS`` harness, so every failure path above is scriptable —
+``tools/check_serving_chaos.py`` proves shed counts, deadline counts,
+breaker transitions and crash-restart bitwise-deterministically in <5s.
 
 Knobs (config.py): ``serving.max_batch`` (MXNET_TPU_SERVING_MAX_BATCH),
 ``serving.max_queue_delay_ms`` (MXNET_TPU_SERVING_MAX_QUEUE_DELAY_MS),
-``serving.compile_cache_dir`` (MXNET_TPU_SERVING_COMPILE_CACHE_DIR); the
+``serving.compile_cache_dir`` (MXNET_TPU_SERVING_COMPILE_CACHE_DIR),
+``serving.max_pending`` (MXNET_TPU_SERVING_MAX_PENDING),
+``serving.default_deadline_ms`` (MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS),
+``serving.breaker_threshold`` / ``serving.breaker_cooldown_ms``; the
 bucket POLICY is the shared ``io.pad_buckets`` knob.  docs/SERVING.md has
-the full architecture note.
+the full architecture + fault-tolerance note.
 """
 from __future__ import annotations
 
@@ -61,6 +100,7 @@ import threading
 import time as _time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 
 import numpy as _np
 
@@ -70,36 +110,181 @@ from . import config as _config
 from . import io as _io
 from . import telemetry as _telemetry
 
-__all__ = ["Server", "ServingError", "load_server"]
+__all__ = ["Server", "ServingError", "ServerOverloadedError",
+           "DeadlineExceededError", "CircuitOpenError", "load_server"]
 
 _LOG = logging.getLogger("mxnet_tpu.serving")
+
+#: sleep injected by the ``serving_slow`` fault kind: long enough to trip a
+#: sub-second watchdog interval and make shed/deadline schedules
+#: deterministic, short enough that chaos smokes stay under their budget.
+_SLOW_DISPATCH_S = 0.25
 
 
 class ServingError(RuntimeError):
     """Raised for serving lifecycle errors (stopped server, evicted or
-    unknown model, oversized request on a fixed-batch artifact)."""
+    unknown model, oversized request on a fixed-batch artifact, dead
+    batcher)."""
+
+
+class ServerOverloadedError(ServingError, OSError):
+    """The pending queue is at ``serving.max_pending``: the request was
+    shed instead of queued.  Subclasses OSError so
+    ``resilience.call_with_retry`` treats it as retryable — back off and
+    resubmit."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired while it was still queued: it was
+    completed with this error at batch-formation time and never
+    dispatched (or cancelled by ``predict(timeout=...)``)."""
+
+
+class CircuitOpenError(ServingError, OSError):
+    """The model's circuit breaker is open after consecutive dispatch
+    failures: failing fast instead of queuing onto a broken model.
+    Retryable (OSError subclass) — the breaker goes half-open after its
+    cooldown and probes with a single batch."""
+
+
+class _BatcherCrashError(OSError):
+    """Internal: wraps an arbitrary batcher-loop crash so
+    ``resilience.call_with_retry`` (which retries OSError) drives the
+    restart backoff and bounds the restart budget."""
 
 
 class _Request:
     """One caller request: host-side rows plus the future its output rows
-    resolve, stamped with the submit time for queue-delay accounting."""
+    resolve, stamped with the submit time for queue-delay accounting and
+    an optional absolute deadline."""
 
-    __slots__ = ("model", "data", "rows", "future", "t_submit")
+    __slots__ = ("model", "data", "rows", "future", "t_submit", "deadline")
 
-    def __init__(self, model, data, future):
+    def __init__(self, model, data, future, deadline_ms=0.0):
         self.model = model
         self.data = data
         self.rows = int(data.shape[0])
         self.future = future
         self.t_submit = _time.perf_counter()
+        self.deadline = (self.t_submit + float(deadline_ms) * 1e-3) \
+            if deadline_ms and deadline_ms > 0 else None
+
+    def expired(self, now=None):
+        if self.deadline is None:
+            return False
+        return (now if now is not None else _time.perf_counter()) \
+            >= self.deadline
+
+
+_BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class _Breaker:
+    """Per-model circuit breaker: ``closed`` → ``open`` after
+    ``threshold`` consecutive dispatch failures → ``half_open`` once the
+    cooldown elapses (ONE probe batch goes through) → ``closed`` on probe
+    success / back to ``open`` on probe failure.  ``threshold <= 0``
+    disables the breaker (every check short-circuits)."""
+
+    __slots__ = ("model", "threshold", "cooldown_s", "state", "failures",
+                 "opened_at", "_lock")
+
+    def __init__(self, model, threshold, cooldown_s):
+        self.model = model
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self._lock = threading.Lock()
+
+    def _set_state(self, state):
+        self.state = state
+        _telemetry.gauge("serving.breaker_state.%s" % self.model).set(
+            _BREAKER_STATE_VALUE[state])
+
+    def cooldown_remaining_ms(self):
+        return max(0.0, (self.cooldown_s
+                         - (_time.perf_counter() - self.opened_at))) * 1e3
+
+    def rejects_submit(self):
+        """Fast-fail check on the submit path: only while OPEN and still
+        inside the cooldown.  Once the cooldown elapses submits are
+        accepted again — they feed the half-open probe."""
+        if self.threshold <= 0 or self.state != "open":
+            return False
+        return _time.perf_counter() - self.opened_at < self.cooldown_s
+
+    def allow_dispatch(self):
+        """Dispatch-side gate: closed/half-open batches dispatch; an open
+        breaker whose cooldown elapsed transitions to half-open and lets
+        this ONE batch through as the probe."""
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self.state != "open":
+                return True
+            if _time.perf_counter() - self.opened_at < self.cooldown_s:
+                return False
+            self._set_state("half_open")
+        _LOG.info("serving: breaker for model %r half-open after %.0fms "
+                  "cooldown; probing with one batch",
+                  self.model, self.cooldown_s * 1e3)
+        return True
+
+    def record_success(self):
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            closing = self.state != "closed"
+            self.failures = 0
+            if closing:
+                self._set_state("closed")
+        if closing:
+            _LOG.info("serving: breaker for model %r closed after a "
+                      "successful probe", self.model)
+
+    def record_failure(self):
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            if self.state == "half_open":
+                # the probe failed: straight back to open, fresh cooldown
+                self.failures += 1
+                self.opened_at = _time.perf_counter()
+                self._set_state("open")
+                opened = True
+            else:
+                self.failures += 1
+                opened = self.state == "closed" \
+                    and self.failures >= self.threshold
+                if opened:
+                    self.opened_at = _time.perf_counter()
+                    self._set_state("open")
+        if opened:
+            _telemetry.counter("serving.breaker_open").inc()
+            _telemetry.counter("serving.breaker_open.%s" % self.model).inc()
+            try:
+                from . import tracing as _tracing
+                _tracing.record_event(
+                    "serving", "breaker_open", model=self.model,
+                    failures=self.failures)
+            except Exception:  # noqa: BLE001 — telemetry must not break it
+                pass
+            _LOG.warning(
+                "serving: breaker for model %r OPEN after %d consecutive "
+                "dispatch failure(s); failing fast for %.0fms",
+                self.model, self.failures, self.cooldown_s * 1e3)
 
 
 class _ModelEntry:
-    """A registered model: reloaded artifact, device-resident params, and
-    the per-bucket AOT program table."""
+    """A registered model: reloaded artifact, device-resident params, the
+    per-bucket AOT program table, plus its breaker and fault-tolerance
+    tallies (cumulative shed / deadline-expired requests)."""
 
     __slots__ = ("name", "prefix", "predictor", "buckets", "programs",
-                 "item_shape", "in_dtype")
+                 "item_shape", "in_dtype", "breaker", "shed",
+                 "deadline_exceeded")
 
     def __init__(self, name, prefix, predictor, buckets):
         self.name = name
@@ -111,6 +296,9 @@ class _ModelEntry:
         self.item_shape = tuple(int(s) for s in shape[1:])
         self.in_dtype = _np.dtype(predictor.meta.get("input_dtype",
                                                      "float32"))
+        self.breaker = None   # assigned by Server.register
+        self.shed = 0
+        self.deadline_exceeded = 0
 
     @property
     def capacity(self):
@@ -165,26 +353,53 @@ class Server:
     larger than the biggest bucket are transparently split into chunks and
     their outputs re-concatenated.  ``Server`` is also a context manager
     (``with Server() as srv: ...`` starts and drains it).
+
+    Fault tolerance (docs/SERVING.md): submits past ``max_pending`` shed
+    with :class:`ServerOverloadedError`; ``submit(deadline_ms=...)``
+    requests that expire in queue complete with
+    :class:`DeadlineExceededError` and never dispatch; a per-model
+    breaker fails a broken model fast (:class:`CircuitOpenError`) while
+    other models keep serving; and the batcher thread is supervised —
+    a crash fails pending futures with the causal exception and restarts
+    the loop under the ``mx.resilience`` retry budget.
     """
 
     def __init__(self, max_batch=None, max_queue_delay_ms=None,
-                 buckets=None, max_models=8):
+                 buckets=None, max_models=8, max_pending=None,
+                 default_deadline_ms=None, breaker_threshold=None,
+                 breaker_cooldown_ms=None):
         if max_batch is None:
             max_batch = _config.get("serving.max_batch")
         if max_queue_delay_ms is None:
             max_queue_delay_ms = _config.get("serving.max_queue_delay_ms")
         if buckets is None:
             buckets = _config.get("io.pad_buckets")
+        if max_pending is None:
+            max_pending = _config.get("serving.max_pending")
+        if default_deadline_ms is None:
+            default_deadline_ms = _config.get("serving.default_deadline_ms")
+        if breaker_threshold is None:
+            breaker_threshold = _config.get("serving.breaker_threshold")
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = _config.get("serving.breaker_cooldown_ms")
         self.max_batch = int(max_batch)
         self.max_queue_delay_ms = float(max_queue_delay_ms)
         self._bucket_policy = buckets
         self.max_models = int(max_models)
+        self.max_pending = int(max_pending)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_ms = float(breaker_cooldown_ms)
         self._models = OrderedDict()     # name -> _ModelEntry (LRU order)
         self._pending = deque()
         self._cond = threading.Condition()
         self._thread = None
+        self._leaked_thread = None       # batcher that missed stop()'s join
+        self._batcher_dead = None        # causal exc once restarts exhaust
         self._started = False
         self._stopping = False
+        self._last_dispatch_done = _time.perf_counter()
+        self._probe_name = "serving-%x" % id(self)
 
     # ------------------------------------------------------------ models
     def _policy_buckets(self, cap):
@@ -197,9 +412,10 @@ class Server:
         """Load the ``mx.deploy`` artifact at ``prefix`` under ``name``:
         params go device-resident now; bucket programs compile now if the
         server is already started (else at :meth:`start`).  Re-registering
-        a name replaces the entry.  The table is LRU-bounded at
-        ``max_models`` — registering past it evicts the least recently
-        used model (its programs and device params become collectable)."""
+        a name replaces the entry (and resets its breaker).  The table is
+        LRU-bounded at ``max_models`` — registering past it evicts the
+        least recently used model (its programs and device params become
+        collectable)."""
         from . import deploy as _deploy
         predictor = _deploy.StableHLOPredictor(prefix)
         if predictor._params is None:
@@ -216,6 +432,8 @@ class Server:
             fixed = int(predictor.meta["input_shape"][0])
             buckets = (fixed,)
         entry = _ModelEntry(name, prefix, predictor, buckets)
+        entry.breaker = _Breaker(name, self.breaker_threshold,
+                                 self.breaker_cooldown_ms * 1e-3)
         with self._cond:
             self._models.pop(name, None)
             self._models[name] = entry
@@ -279,30 +497,47 @@ class Server:
     def start(self):
         """Compile every registered ``(model, bucket)`` program eagerly
         (restart-warm via the persistent compile cache when
-        ``serving.compile_cache_dir`` is set) and start the batcher
-        thread.  Idempotent while running; restartable after ``stop``."""
+        ``serving.compile_cache_dir`` is set) and start the supervised
+        batcher thread.  Idempotent while running; restartable after
+        ``stop`` — unless a previous batcher missed its join deadline and
+        is STILL running, in which case this raises instead of racing two
+        batchers on one queue (the ``PrefetchingIter.reset`` contract)."""
         from . import tracing as _tracing
         if self._started:
             return self
+        if self._leaked_thread is not None:
+            if self._leaked_thread.is_alive():
+                raise ServingError(
+                    "a previous batcher thread missed its stop() join "
+                    "deadline and is still running; refusing to start a "
+                    "second batcher over the same queue — wait for it to "
+                    "exit (then start() again) or recreate the Server")
+            self._leaked_thread = None
         _configure_compile_cache()
         with self._cond:
             entries = list(self._models.values())
         for entry in entries:
             self._compile_entry(entry)
         self._stopping = False
+        self._batcher_dead = None
+        self._last_dispatch_done = _time.perf_counter()
         self._started = True
         # wrap_context: dispatch spans keep the starter's trace parentage
         # across the thread hop (the io.prefetch pattern)
         self._thread = threading.Thread(
-            target=_tracing.wrap_context(self._loop), daemon=True,
+            target=_tracing.wrap_context(self._supervise), daemon=True,
             name="mx-serving-batcher")
         self._thread.start()
+        _tracing.register_stall_probe(self._probe_name, self._stall_probe)
         return self
 
     def stop(self, drain=True, timeout_s=30.0):
         """Stop the server.  New submits fail immediately; with ``drain``
         (default) every already-queued request is dispatched before the
-        batcher exits, so no accepted future is left unresolved."""
+        batcher exits, so no accepted future is left unresolved; with
+        ``drain=False`` pending futures fail promptly with ServingError.
+        A batcher that misses the join deadline is remembered — a later
+        ``start()`` refuses while it is still alive."""
         with self._cond:
             if not self._started:
                 return
@@ -310,19 +545,26 @@ class Server:
             if not drain:
                 abandoned = list(self._pending)
                 self._pending.clear()
+                _telemetry.gauge("serving.pending").set(0)
             else:
                 abandoned = []
             self._cond.notify_all()
         for req in abandoned:
-            req.future.set_exception(
-                ServingError("server stopped without drain"))
+            if not req.future.done():
+                req.future.set_exception(
+                    ServingError("server stopped without drain"))
         thread = self._thread
         if thread is not None:
             thread.join(timeout=timeout_s)
             if thread.is_alive():
                 _telemetry.counter("serving.stop_timeout").inc()
-                _LOG.warning("serving: batcher did not drain within %.1fs",
-                             timeout_s)
+                self._leaked_thread = thread
+                _LOG.warning(
+                    "serving: batcher did not drain within %.1fs and was "
+                    "leaked; start() will refuse until it exits",
+                    timeout_s)
+        from . import tracing as _tracing
+        _tracing.unregister_stall_probe(self._probe_name)
         self._started = False
         self._thread = None
 
@@ -353,10 +595,18 @@ class Server:
         if arr.shape[0] < 1:
             raise ValueError("model %r: empty request" % (entry.name,))
 
-    def submit(self, name, data):
+    def submit(self, name, data, deadline_ms=None):
         """Enqueue one request (any row count) for model ``name``; returns
         a ``concurrent.futures.Future`` resolving to the host numpy output
-        rows for exactly the submitted rows (padding is invisible)."""
+        rows for exactly the submitted rows (padding is invisible).
+
+        ``deadline_ms`` (default: the ``serving.default_deadline_ms``
+        knob; 0 = none) bounds how long the request may sit in queue: a
+        request still queued past it completes with
+        :class:`DeadlineExceededError` and is never dispatched.  Raises
+        :class:`ServerOverloadedError` when the pending queue is at
+        ``serving.max_pending`` and :class:`CircuitOpenError` while the
+        model's breaker is open."""
         from . import tracing as _tracing
         from .ndarray.ndarray import NDArray
         with _tracing.span("serving.submit", cat="serving", model=name):
@@ -365,14 +615,43 @@ class Server:
                               else data)
             self._validate(entry, arr)
             _telemetry.counter("serving.requests").inc()
+            breaker = entry.breaker
+            if breaker is not None and breaker.rejects_submit():
+                _telemetry.counter("serving.breaker_rejected").inc()
+                raise CircuitOpenError(
+                    "model %r circuit breaker is OPEN after %d "
+                    "consecutive dispatch failure(s); failing fast for "
+                    "%.0fms more — other models keep serving, retry "
+                    "after the cooldown"
+                    % (name, breaker.failures,
+                       breaker.cooldown_remaining_ms()))
+            if deadline_ms is None:
+                deadline_ms = self.default_deadline_ms
+            deadline_ms = float(deadline_ms or 0.0)
             cap = entry.capacity
             if arr.shape[0] <= cap:
-                return self._enqueue(_Request(name, arr, Future()))
+                req = _Request(name, arr, Future(), deadline_ms)
+                fut = self._enqueue(req)
+                fut._mx_requests = (req,)
+                return fut
             # oversized request: split into cap-row chunks, re-concatenate
             chunks = [arr[i:i + cap] for i in range(0, arr.shape[0], cap)]
             _telemetry.counter("serving.request_chunks").inc(len(chunks))
-            futures = [self._enqueue(_Request(name, c, Future()))
-                       for c in chunks]
+            reqs = [_Request(name, c, Future(), deadline_ms)
+                    for c in chunks]
+            enqueued = []
+            try:
+                for r in reqs:
+                    self._enqueue(r)
+                    enqueued.append(r)
+            except BaseException:
+                # admission failed mid-way: unwind the sibling chunks so
+                # no queued orphan is dispatched for a dead combined future
+                self._cancel_queued(enqueued, ServingError(
+                    "sibling chunk was rejected; oversized request "
+                    "aborted"))
+                raise
+            futures = [r.future for r in reqs]
             combined = Future()
             remaining = [len(futures)]
             lock = threading.Lock()
@@ -391,32 +670,184 @@ class Server:
 
             for f in futures:
                 f.add_done_callback(_one_done)
+            combined._mx_requests = tuple(reqs)
             return combined
 
     def _enqueue(self, req):
+        shed = False
         with self._cond:
+            if self._batcher_dead is not None:
+                exc = self._batcher_dead
+                raise ServingError(
+                    "batcher thread crashed (%s: %s) and exhausted its "
+                    "restart budget (resilience.retry_attempts); submit() "
+                    "rejected — recreate the Server"
+                    % (type(exc).__name__, exc))
             if self._stopping or not self._started:
                 raise ServingError(
                     "server is %s; submit() rejected"
                     % ("stopping" if self._stopping else "not started"))
-            self._pending.append(req)
-            _telemetry.gauge("serving.pending").set(len(self._pending))
-            self._cond.notify_all()
+            if self.max_pending > 0 \
+                    and len(self._pending) >= self.max_pending:
+                entry = self._models.get(req.model)
+                if entry is not None:
+                    entry.shed += 1
+                shed = True
+            else:
+                self._pending.append(req)
+                _telemetry.gauge("serving.pending").set(len(self._pending))
+                self._cond.notify_all()
+        if shed:
+            _telemetry.counter("serving.shed_requests").inc()
+            _telemetry.counter("serving.shed_requests.%s" % req.model).inc()
+            raise ServerOverloadedError(
+                "server overloaded: %d request(s) already pending "
+                "(serving.max_pending=%d); request shed — back off and "
+                "retry" % (self.max_pending, self.max_pending))
         return req.future
 
-    def predict(self, name, data, timeout=None):
-        """Synchronous convenience: ``submit(...).result(timeout)``."""
-        return self.submit(name, data).result(timeout)
+    def _cancel_queued(self, reqs, exc):
+        """Remove still-queued requests and fail their futures with
+        ``exc``; requests already popped into a forming batch are left to
+        complete.  Returns the list actually cancelled."""
+        removed = []
+        with self._cond:
+            for req in reqs:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    continue
+                removed.append(req)
+            if removed:
+                _telemetry.gauge("serving.pending").set(len(self._pending))
+        for req in removed:
+            if not req.future.done():
+                req.future.set_exception(exc)
+        return removed
+
+    def predict(self, name, data, timeout=None, deadline_ms=None):
+        """Synchronous convenience: ``submit(...).result(timeout)``.  On
+        timeout the queued request is CANCELLED (completed with
+        :class:`DeadlineExceededError`, never dispatched) instead of
+        left to burn compute for a caller that gave up; a request
+        already mid-dispatch completes normally but the call still raises
+        DeadlineExceededError."""
+        fut = self.submit(name, data, deadline_ms=deadline_ms)
+        try:
+            return fut.result(timeout)
+        except _FutureTimeout:
+            reqs = getattr(fut, "_mx_requests", ())
+            cancelled = self._cancel_queued(reqs, DeadlineExceededError(
+                "predict(%r) timed out after %.3fs; queued request "
+                "cancelled before dispatch" % (name, timeout)))
+            for req in cancelled:
+                self._count_deadline_exceeded(req.model)
+            raise DeadlineExceededError(
+                "predict(%r) timed out after %.3fs (%d queued chunk(s) "
+                "cancelled undispatched)"
+                % (name, timeout, len(cancelled))) from None
+
+    def _count_deadline_exceeded(self, model):
+        _telemetry.counter("serving.deadline_exceeded").inc()
+        _telemetry.counter("serving.deadline_exceeded.%s" % model).inc()
+        with self._cond:
+            entry = self._models.get(model)
+            if entry is not None:
+                entry.deadline_exceeded += 1
 
     # ----------------------------------------------------------- batcher
     def _take_fitting(self, model, budget):
         """Pop the first queued request for ``model`` with rows <=
-        ``budget`` (caller holds the condition lock)."""
-        for i, req in enumerate(self._pending):
-            if req.model == model and req.rows <= budget:
-                del self._pending[i]
-                return req
-        return None
+        ``budget`` (caller holds the condition lock).  Queued requests
+        whose deadline has expired are harvested as a second return value —
+        the caller completes them typed, they are never dispatched."""
+        now = _time.perf_counter()
+        take = None
+        dead = []
+        for req in self._pending:
+            if req.expired(now):
+                dead.append(req)
+                continue
+            if take is None and req.model == model and req.rows <= budget:
+                take = req
+        for req in dead:
+            self._pending.remove(req)
+        if take is not None:
+            self._pending.remove(take)
+        if dead or take is not None:
+            _telemetry.gauge("serving.pending").set(len(self._pending))
+        return take, dead
+
+    def _expire(self, reqs, reason="expired in queue before dispatch"):
+        """Complete deadline-expired requests with the typed error; they
+        never reach a program — no compute is wasted on them."""
+        for req in reqs:
+            self._count_deadline_exceeded(req.model)
+            if not req.future.done():
+                req.future.set_exception(DeadlineExceededError(
+                    "request for model %r %s (queued %.1fms, deadline "
+                    "passed)" % (req.model, reason,
+                                 (_time.perf_counter() - req.t_submit)
+                                 * 1e3)))
+
+    def _supervise(self):
+        """Batcher supervisor (the thread target): runs ``_loop`` under
+        the ``mx.resilience`` retry budget.  Each crash fails the pending
+        futures with the causal exception and restarts the loop after
+        backoff; once the budget is exhausted the server is marked dead —
+        ``submit()`` then fails fast instead of hanging forever."""
+        from . import resilience as _resilience
+        try:
+            _resilience.call_with_retry(self._run_batcher,
+                                        kind="serving_batcher")
+        except BaseException as exc:  # noqa: BLE001 — budget exhausted
+            cause = exc.__cause__ if exc.__cause__ is not None else exc
+            with self._cond:
+                self._batcher_dead = cause
+                pending = list(self._pending)
+                self._pending.clear()
+                _telemetry.gauge("serving.pending").set(0)
+                self._cond.notify_all()
+            for req in pending:
+                if not req.future.done():
+                    req.future.set_exception(cause)
+            _LOG.error(
+                "serving: batcher crashed and exhausted its restart "
+                "budget (%s: %s); all submits now fail fast — recreate "
+                "the Server", type(cause).__name__, cause)
+
+    def _run_batcher(self):
+        """One supervised batcher incarnation: a clean ``_loop`` return
+        (stop/drain) ends the thread; a crash fails every pending future
+        with the CAUSAL exception, counts ``serving.batcher_crashes``,
+        flight-records the crash, and re-raises as a retryable wrapper so
+        the supervisor's ``call_with_retry`` restarts it with backoff."""
+        try:
+            self._loop()
+        except BaseException as exc:  # noqa: BLE001 — supervised crash
+            _telemetry.counter("serving.batcher_crashes").inc()
+            try:
+                from . import tracing as _tracing
+                _tracing.record_event(
+                    "serving", "batcher_crash",
+                    error="%s: %s" % (type(exc).__name__, exc))
+            except Exception:  # noqa: BLE001
+                pass
+            with self._cond:
+                pending = list(self._pending)
+                self._pending.clear()
+                _telemetry.gauge("serving.pending").set(0)
+            for req in pending:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            _LOG.warning(
+                "serving: batcher thread crashed (%s: %s); %d pending "
+                "future(s) failed with the causal exception; restarting "
+                "under the resilience retry budget",
+                type(exc).__name__, exc, len(pending))
+            raise _BatcherCrashError(
+                "serving batcher crashed: %s: %s"
+                % (type(exc).__name__, exc)) from exc
 
     def _loop(self):
         while True:
@@ -432,32 +863,73 @@ class Server:
                 first.future.set_exception(ServingError(
                     "model %r was evicted while queued" % (first.model,)))
                 continue
+            if first.expired():
+                self._expire([first])
+                continue
             batch = [first]
             rows = first.rows
             cap = entry.capacity
             deadline = first.t_submit + self.max_queue_delay_ms * 1e-3
             while rows < cap:
                 with self._cond:
-                    req = self._take_fitting(first.model, cap - rows)
+                    req, expired = self._take_fitting(first.model,
+                                                      cap - rows)
+                    wait = None
                     if req is None:
                         remaining = deadline - _time.perf_counter()
                         if remaining <= 0 or self._stopping:
-                            break
-                        self._cond.wait(timeout=min(remaining, 0.005))
-                        continue
+                            wait = 0.0
+                        else:
+                            wait = min(remaining, 0.005)
+                if expired:
+                    self._expire(expired)
                 if req is not None:
                     batch.append(req)
                     rows += req.rows
+                    continue
+                if wait == 0.0:
+                    break
+                with self._cond:
+                    self._cond.wait(timeout=wait)
+            # batch-formation deadline check: anything that expired while
+            # the coalescing window was open completes typed, undispatched
+            now = _time.perf_counter()
+            dead = [r for r in batch if r.expired(now)]
+            if dead:
+                self._expire(dead)
+                batch = [r for r in batch if not r.expired(now)]
+                if not batch:
+                    continue
+                rows = sum(r.rows for r in batch)
             self._dispatch(entry, batch, rows)
 
     def _dispatch(self, entry, batch, rows):
+        from . import resilience as _resilience
         from . import tracing as _tracing
         t0 = _time.perf_counter()
         bucket = _io.pick_bucket(entry.buckets, rows) or entry.capacity
         for req in batch:
             _telemetry.timer("serving.queue_delay_ms").observe(
                 (t0 - req.t_submit) * 1e3)
+        breaker = entry.breaker
+        if breaker is not None and not breaker.allow_dispatch():
+            # open breaker, cooldown still running: fail the batch fast
+            # (requests admitted before the breaker opened)
+            _telemetry.counter("serving.breaker_rejected").inc(len(batch))
+            exc = CircuitOpenError(
+                "model %r circuit breaker is OPEN (%d consecutive "
+                "dispatch failure(s)); batch failed fast, retry after "
+                "the cooldown" % (entry.name, breaker.failures))
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            self._last_dispatch_done = _time.perf_counter()
+            return
         try:
+            if _resilience.faults_active("serving_slow") \
+                    and _resilience.should_inject("serving_slow"):
+                _time.sleep(_SLOW_DISPATCH_S)
+            _resilience.inject("serving_dispatch")
             cat = batch[0].data if len(batch) == 1 else \
                 _np.concatenate([req.data for req in batch], axis=0)
             padded = _io.pad_rows_to(cat, bucket) if bucket > rows else cat
@@ -475,25 +947,32 @@ class Server:
                 out = out[0]
             host = _np.asarray(out)
         except BaseException as exc:  # noqa: BLE001 — fail the batch's
-            # futures, never the batcher thread itself
+            # futures (and feed the breaker), never the batcher thread
             _telemetry.counter("serving.dispatch_errors").inc()
+            if breaker is not None:
+                breaker.record_failure()
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)
+            self._last_dispatch_done = _time.perf_counter()
             return
+        if breaker is not None:
+            breaker.record_success()
         t1 = _time.perf_counter()
         ofs = 0
         for req in batch:
-            req.future.set_result(host[ofs:ofs + req.rows])
+            if not req.future.done():
+                req.future.set_result(host[ofs:ofs + req.rows])
             ofs += req.rows
             _telemetry.timer("serving.request_ms").observe(
                 (t1 - req.t_submit) * 1e3)
         _telemetry.counter("serving.batch_dispatches").inc()
         _telemetry.timer("serving.batch_fill").observe(rows / bucket)
         _telemetry.timer("serving.dispatch_ms").observe((t1 - t0) * 1e3)
+        self._last_dispatch_done = t1
         # one JSONL record per dispatch (no-op when the sink is off);
-        # tools/telemetry_report.py folds these into the serving table and
-        # the queue-delay anomaly check
+        # tools/telemetry_report.py folds these into the serving table,
+        # the queue-delay anomaly and the overload-shedding anomaly
         if _telemetry.enabled():
             _telemetry.log_event(
                 "serving", model=entry.name, requests=len(batch),
@@ -502,26 +981,88 @@ class Server:
                 queue_delay_ms=round(max(
                     (t0 - req.t_submit) * 1e3 for req in batch), 4),
                 wall_ms=round((t1 - t0) * 1e3, 4),
-                budget_ms=self.max_queue_delay_ms)
+                budget_ms=self.max_queue_delay_ms,
+                shed=entry.shed,
+                deadline_exceeded=entry.deadline_exceeded,
+                breaker=breaker.state if breaker is not None else "closed")
+
+    # ---------------------------------------------------------- watchdog
+    def _stall_probe(self, interval_s):
+        """PR-3 watchdog hook (``tracing.register_stall_probe``): when
+        the queue is non-empty but no dispatch has completed within the
+        watchdog interval, return a flight-recordable snapshot — open
+        requests, breaker states, batcher liveness.  None while
+        healthy."""
+        now = _time.perf_counter()
+        with self._cond:
+            if not self._pending:
+                return None
+            stalled_s = now - self._last_dispatch_done
+            if stalled_s < interval_s:
+                return None
+            open_reqs = [
+                {"model": r.model, "rows": r.rows,
+                 "queued_s": round(now - r.t_submit, 4),
+                 "deadline_in_s": round(r.deadline - now, 4)
+                 if r.deadline is not None else None}
+                for r in list(self._pending)[:16]]
+            pending = len(self._pending)
+            breakers = {name: e.breaker.state if e.breaker is not None
+                        else "closed"
+                        for name, e in self._models.items()}
+            thread = self._thread
+        return {"pending": pending,
+                "since_last_dispatch_s": round(stalled_s, 4),
+                "batcher_alive": bool(thread is not None
+                                      and thread.is_alive()),
+                "open_requests": open_reqs,
+                "breakers": breakers}
 
     # ------------------------------------------------------------- stats
     def stats(self):
-        """Serving-slice snapshot of the telemetry registry (counters and
-        timer histograms whose names start with ``serving.``)."""
+        """Serving-slice snapshot of the telemetry registry (counters,
+        gauges and timer histograms whose names start with ``serving.``)
+        plus live server state: registered models, queue depth, breaker
+        states, batcher liveness."""
         snap = _telemetry.snapshot()
+        with self._cond:
+            breakers = {name: e.breaker.state if e.breaker is not None
+                        else "closed"
+                        for name, e in self._models.items()}
+            pending = len(self._pending)
+            thread = self._thread
         return {
             "counters": {k: v for k, v in snap["counters"].items()
                          if k.startswith("serving.")},
+            "gauges": {k: v for k, v in snap["gauges"].items()
+                       if k.startswith("serving.")},
             "timers": {k: v for k, v in snap["timers"].items()
                        if k.startswith("serving.")},
             "models": self.models(),
+            "pending": pending,
+            "breakers": breakers,
+            "batcher_alive": bool(thread is not None and thread.is_alive()),
         }
 
 
 def load_server(prefixes, **kwargs):
     """Convenience: build, register and start a server from
-    ``{name: prefix}``."""
+    ``{name: prefix}``.  All-or-nothing: if any ``register()`` (or the
+    ``start()``) raises, previously registered models — and with them any
+    staged params / compiled programs — are unwound before the exception
+    propagates, so a partial failure cannot keep device memory alive
+    through the raised traceback."""
     srv = Server(**kwargs)
-    for name, prefix in dict(prefixes).items():
-        srv.register(name, prefix)
-    return srv.start()
+    registered = []
+    try:
+        for name, prefix in dict(prefixes).items():
+            srv.register(name, prefix)
+            registered.append(name)
+        return srv.start()
+    except BaseException:
+        for name in registered:
+            try:
+                srv.unregister(name)
+            except Exception:  # noqa: BLE001 — unwind is best-effort
+                pass
+        raise

@@ -51,7 +51,8 @@ __all__ = ["span", "current_span", "wrap_context", "configure_sink",
            "configure_watchdog", "configure_ring", "enabled", "sink_path",
            "open_spans", "ring_events", "record_event", "notify_step",
            "dump_watchdog_report", "load_trace", "validate_trace_events",
-           "validate_watchdog_report", "Span"]
+           "validate_watchdog_report", "register_stall_probe",
+           "unregister_stall_probe", "check_stall_probes", "Span"]
 
 # ------------------------------------------------------------- span context
 #: the active span for the calling context.  contextvars (not thread-local)
@@ -342,6 +343,49 @@ _WD_REPORT_DIR = ""
 # measures hang age against this
 _LAST_PROGRESS = [time.perf_counter()]
 
+# stall probes: name -> fn(interval_s) -> dict|None.  Subsystems with their
+# own liveness signal (e.g. the mx.serving batcher, whose queue can stall
+# while train steps keep completing) register here; the watchdog polls them
+# alongside the step-age check and flight-records whatever dict a probe
+# returns.  Probes must be fast, thread-safe, and never raise (exceptions
+# are swallowed — the watchdog must not die).
+_PROBE_LOCK = threading.Lock()
+_STALL_PROBES = {}
+
+
+def register_stall_probe(name, fn):
+    """Register a watchdog stall probe.  ``fn(interval_s)`` is called from
+    the watchdog thread each poll; it returns None while healthy, or a
+    JSON-serializable dict describing the stall (the dict lands in the
+    flight-recorder ring and the watchdog report's ``stalls`` section).
+    Re-registering a name replaces the probe."""
+    with _PROBE_LOCK:
+        _STALL_PROBES[name] = fn
+
+
+def unregister_stall_probe(name):
+    with _PROBE_LOCK:
+        _STALL_PROBES.pop(name, None)
+
+
+def check_stall_probes(interval_s):
+    """Run every registered stall probe against ``interval_s`` and return
+    ``{name: info}`` for those reporting a stall.  Probe exceptions are
+    swallowed (a broken probe must not take the watchdog down).  Public so
+    tests and on-demand dumps can evaluate probes without a live
+    watchdog."""
+    with _PROBE_LOCK:
+        probes = list(_STALL_PROBES.items())
+    stalls = {}
+    for name, fn in probes:
+        try:
+            info = fn(interval_s)
+        except Exception:  # noqa: BLE001 — the watchdog must not die
+            continue
+        if info:
+            stalls[name] = info
+    return stalls
+
 
 def notify_step(source, step, wall_s, error=None):
     """Called by ``telemetry.step_scope`` on every completed train step —
@@ -389,7 +433,27 @@ def _watchdog_loop(deadline, stop):
     last_seen = _LAST_PROGRESS[0]
     fires = 0               # consecutive reports with no progress between
     next_fire_age = deadline
+    probe_next = {}         # per-probe refire backoff (perf_counter floor)
     while not stop.wait(poll):
+        # subsystem stall probes run on their own liveness signal: a
+        # serving-queue stall is a stall even while train steps complete
+        now = time.perf_counter()
+        stalls = {name: info
+                  for name, info in check_stall_probes(deadline).items()
+                  if probe_next.get(name, 0.0) <= now}
+        for name, info in stalls.items():
+            probe_next[name] = now + deadline * 4  # refire backoff
+            record_event("stall", name, **info)
+            from . import telemetry as _telemetry
+            _telemetry.counter("tracing.stall_probe_fires").inc()
+            try:
+                path = dump_watchdog_report(stalls={name: info})
+                print("mxnet_tpu watchdog: stall probe %r fired — "
+                      "flight-recorder report: %s" % (name, path),
+                      file=sys.stderr)
+            except Exception as exc:  # noqa: BLE001 — must not die
+                print("mxnet_tpu watchdog: stall report dump failed: %s"
+                      % (exc,), file=sys.stderr)
         progress = _LAST_PROGRESS[0]
         if progress != last_seen:
             last_seen = progress
@@ -434,11 +498,13 @@ def _thread_stacks():
     return out
 
 
-def dump_watchdog_report(stalled_s=None, path=None):
+def dump_watchdog_report(stalled_s=None, path=None, stalls=None):
     """Write the flight-recorder report: thread stacks, open spans with
     ages, the event ring, device memory, and telemetry gauge/counter
-    snapshots.  Public so a debugger (or a SIGQUIT handler) can dump the
-    same artifact on demand; returns the report path."""
+    snapshots.  ``stalls`` ({probe_name: info}) attaches subsystem
+    stall-probe findings — e.g. the mx.serving probe's open requests and
+    breaker states.  Public so a debugger (or a SIGQUIT handler) can dump
+    the same artifact on demand; returns the report path."""
     from . import telemetry as _telemetry
     snap = _telemetry.snapshot()
     if stalled_s is None:
@@ -456,6 +522,8 @@ def dump_watchdog_report(stalled_s=None, path=None):
         "gauges": snap["gauges"],
         "counters": snap["counters"],
     }
+    if stalls:
+        report["stalls"] = stalls
     if path is None:
         stamp = time.strftime("%Y%m%d_%H%M%S") \
             + "_%03d" % int((time.time() % 1) * 1000)

@@ -26,13 +26,17 @@ device memory.  Anomaly flags:
 
 ``serving`` records (one per mx.serving batch dispatch) get their own
 per-model table — dispatches, requests, rows, mean batch fill, queue-delay
-and dispatch-wall p50/p99, buckets hit — plus the anomaly:
+and dispatch-wall p50/p99, shed and deadline-expired request counts,
+breaker state at the last dispatch, buckets hit — plus the anomalies:
 
   * queue-delay blowup — p99 queue delay > 3x the configured
     max_queue_delay_ms budget (and over the latency floor) across >= 10
     dispatches: the batcher can't keep up with offered load (dispatch
     wall time exceeds the arrival rate) so requests queue far past the
     batching window.
+  * overload shedding — more than 10% of offered requests (dispatched +
+    shed) were rejected by admission control across >= 10 dispatches:
+    sustained overload, not a blip the bounded queue absorbed.
 
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # tables + flags
@@ -51,6 +55,7 @@ LATENCY_FLOOR_MS = 10.0  # sub-10ms tails are scheduler noise, not stalls
 THROUGHPUT_DROP = 0.7
 MIN_STEPS_FOR_FLAGS = 10
 QUEUE_DELAY_RATIO = 3.0  # serving p99 queue delay vs the configured budget
+SHED_RATIO = 0.10        # shed / offered load before overload is flagged
 
 
 def load_records(path):
@@ -109,6 +114,17 @@ def _summarize_serving(serving_recs, anomalies):
                    if isinstance(r.get("budget_ms"), (int, float))]
         qd_p50 = _pct(delays, 50)
         qd_p99 = _pct(delays, 99)
+        # shed / deadline_exceeded are CUMULATIVE per-model tallies stamped
+        # on each dispatch record (PR 7): max() recovers the final count
+        # even from an unordered or truncated log; breaker is the state at
+        # the last dispatch seen
+        shed = max((int(r["shed"]) for r in recs
+                    if isinstance(r.get("shed"), int)), default=0)
+        deadline = max((int(r["deadline_exceeded"]) for r in recs
+                        if isinstance(r.get("deadline_exceeded"), int)),
+                       default=0)
+        breaker = next((r["breaker"] for r in reversed(recs)
+                        if isinstance(r.get("breaker"), str)), None)
         tables[model] = {
             "dispatches": len(recs),
             "requests": requests,
@@ -122,6 +138,9 @@ def _summarize_serving(serving_recs, anomalies):
             "wall_ms_p50": round(_pct(walls, 50), 3) if walls else None,
             "wall_ms_p99": round(_pct(walls, 99), 3) if walls else None,
             "buckets": buckets,
+            "shed": shed,
+            "deadline_exceeded": deadline,
+            "breaker": breaker,
         }
         # queue delays should sit near the batching budget; a p99 far past
         # it means arrivals outpace dispatch and the queue is backing up.
@@ -137,6 +156,20 @@ def _summarize_serving(serving_recs, anomalies):
                           "batching budget (> %.1fx): batcher is not "
                           "keeping up with offered load"
                           % (qd_p99, budget, QUEUE_DELAY_RATIO)})
+        # offered load = dispatched requests + shed requests; a shed share
+        # past SHED_RATIO means admission control is rejecting real
+        # traffic, not absorbing a blip — capacity or max_pending is wrong
+        offered = requests + shed
+        if (len(recs) >= MIN_STEPS_FOR_FLAGS and offered > 0 and
+                shed / float(offered) > SHED_RATIO):
+            anomalies.append({
+                "kind": "overload_shedding", "source": model,
+                "detail": "%d of %d offered requests shed (%.1f%% > "
+                          "%.0f%% over %d dispatches): sustained "
+                          "overload, raise capacity or shed earlier "
+                          "upstream"
+                          % (shed, offered, 100.0 * shed / offered,
+                             100.0 * SHED_RATIO, len(recs))})
     return tables
 
 
@@ -273,19 +306,22 @@ def render(summary, bad_lines=0):
     serving = summary.get("serving") or {}
     if serving:
         lines.append("")
-        shdr = ("%-10s %9s %9s %7s %6s %10s %10s %9s %9s %s"
+        shdr = ("%-10s %9s %9s %7s %6s %10s %10s %9s %9s %5s %5s %9s %s"
                 % ("model", "dispatch", "requests", "rows", "fill",
                    "qd_p50ms", "qd_p99ms", "w_p50ms", "w_p99ms",
-                   "buckets"))
+                   "shed", "ddl", "breaker", "buckets"))
         lines.append(shdr)
         lines.append("-" * len(shdr))
         for model, t in serving.items():
-            lines.append("%-10s %9d %9d %7d %6s %10s %10s %9s %9s %s"
+            lines.append("%-10s %9d %9d %7d %6s %10s %10s %9s %9s "
+                         "%5d %5d %9s %s"
                          % (model, t["dispatches"], t["requests"],
                             t["rows"], _fmt(t["fill_mean"]),
                             _fmt(t["queue_delay_ms_p50"]),
                             _fmt(t["queue_delay_ms_p99"]),
                             _fmt(t["wall_ms_p50"]), _fmt(t["wall_ms_p99"]),
+                            t.get("shed", 0), t.get("deadline_exceeded", 0),
+                            t.get("breaker") or "-",
                             ",".join(str(b) for b in t["buckets"])))
     if summary["monitor_events"]:
         lines.append("monitor events: %d" % summary["monitor_events"])
