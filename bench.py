@@ -330,6 +330,11 @@ def run_bench(runs_out):
     except Exception as e:  # noqa: BLE001
         runs_out.append({"mode": "serving",
                          "error": "%s: %s" % (type(e).__name__, e)})
+    try:
+        quantized_serving_config(runs_out, 512 if on_tpu else 128)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "quantized_serving",
+                         "error": "%s: %s" % (type(e).__name__, e)})
 
     result = _summarize(runs_out)
     result.update(platform=platform, device_kind=kind)
@@ -632,6 +637,87 @@ def serving_config(runs_out, requests):
                          round(cont_rps / seq_rps, 2)})
 
 
+def quantized_serving_config(runs_out, requests):
+    """Secondary: INT8 quantized serving vs fp32 serving, requests/s.
+
+    One MLP is exported twice from the same weights — the fp32 v2
+    artifact and the int8-recolored v3 artifact
+    (``mx.quantization.export_quantized``) — and each serves the same
+    ragged request stream through its own continuous-batching Server.
+    requests/s for both land under runs[] with mode "quantized_serving"
+    and surface as the quantized_serving_throughput secondary.  On CPU
+    the throughput delta is INFORMATIONAL (no int8 MXU path; XLA may
+    even emulate int8 slower) — the structural win asserted by the tests
+    is the int8 dot_general in the exported HLO, which on TPU engages
+    the MXU's double-rate int8 path (docs/QUANTIZATION.md)."""
+    import tempfile
+    import threading
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import deploy, quantization, serving, telemetry
+    from mxnet_tpu.gluon import nn
+
+    FEAT, MAX_BATCH, THREADS = 64, 16, 8
+    mx.random.seed(13)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(16))
+    net.initialize()
+    rng = np.random.RandomState(3)
+    calib = [rng.uniform(-1, 1, size=(MAX_BATCH, FEAT)).astype(np.float32)
+             for _ in range(4)]
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_bench_q_")
+    fp32_prefix = os.path.join(tmpdir, "fp32")
+    int8_prefix = os.path.join(tmpdir, "int8")
+    deploy.export_model(net, fp32_prefix, calib[0])
+    cal = quantization.calibrate(net, calib)
+    quantization.export_quantized(net, int8_prefix, cal)
+    measured = deploy.load_model(int8_prefix,
+                                 quantized=True).meta["measured_error"]
+
+    reqs = [rng.uniform(-1, 1, size=(1, FEAT)).astype(np.float32)
+            for _ in range(requests)]
+
+    def drive(prefix, quantized):
+        srv = serving.Server(max_batch=MAX_BATCH, max_queue_delay_ms=2.0)
+        srv.register("mlp", prefix, quantized=quantized)
+        srv.start()
+        try:
+            srv.predict("mlp", reqs[0])         # warm the dispatch path
+            telemetry.timer("serving.queue_delay_ms").reset()
+            shards = [reqs[i::THREADS] for i in range(THREADS)]
+
+            def worker(shard):
+                for f in [srv.submit("mlp", r) for r in shard]:
+                    f.result(timeout=60)
+
+            threads = [threading.Thread(target=worker, args=(s,))
+                       for s in shards]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rps = requests / (time.perf_counter() - t0)
+            qd = telemetry.timer("serving.queue_delay_ms").stats()["p99"]
+        finally:
+            srv.stop()
+        return rps, qd
+
+    fp32_rps, fp32_qd = drive(fp32_prefix, quantized=False)
+    int8_rps, int8_qd = drive(int8_prefix, quantized=True)
+    runs_out.append({"mode": "quantized_serving", "path": "fp32",
+                     "requests": requests, "threads": THREADS,
+                     "requests_s": round(fp32_rps, 1),
+                     "queue_delay_p99_ms": round(fp32_qd, 3)})
+    runs_out.append({"mode": "quantized_serving", "path": "int8",
+                     "requests": requests, "threads": THREADS,
+                     "requests_s": round(int8_rps, 1),
+                     "queue_delay_p99_ms": round(int8_qd, 3),
+                     "measured_error": measured})
+    runs_out.append({"mode": "quantized_serving", "path": "speedup",
+                     "int8_over_fp32": round(int8_rps / fp32_rps, 2)})
+
+
 def _summarize(runs):
     """One JSON result from the completed sweep configs (best bf16 TRAIN
     run wins — inference runs are reported in `runs` but never headline,
@@ -700,6 +786,17 @@ def _summarize(runs):
                 srv_runs["continuous"].get("queue_delay_p99_ms"),
             "batch_fill_mean":
                 srv_runs["continuous"].get("batch_fill_mean"),
+        }
+    q_runs = {r.get("path"): r for r in runs
+              if r.get("mode") == "quantized_serving"}
+    if "int8" in q_runs and "fp32" in q_runs:
+        secondary["quantized_serving_throughput"] = {
+            "int8_requests_s": q_runs["int8"]["requests_s"],
+            "fp32_requests_s": q_runs["fp32"]["requests_s"],
+            "unit": "requests/s",
+            "int8_over_fp32":
+                q_runs.get("speedup", {}).get("int8_over_fp32"),
+            "measured_error": q_runs["int8"].get("measured_error"),
         }
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",

@@ -65,6 +65,7 @@ _LAZY = {
     "contrib": ".contrib",
     "deploy": ".deploy",
     "serving": ".serving",
+    "quantization": ".quantization",
     "config": ".config",
     "compat": ".compat",
     "dlpack": ".dlpack",

@@ -36,21 +36,51 @@ FP32_OPS = {"BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
             "sum", "logsumexp", "CTCLoss"}
 
 
+def _validate_op_names(kwarg, ops):
+    """Reject op-list entries that name no registered operator — a typo in
+    fp32_ops would otherwise silently pin NOTHING to f32 and the policy
+    would look applied while doing nothing (same contract as the config
+    knob validators, e.g. resilience.nanguard).  Tuple entries (the
+    reference's conditional_fp32_ops (op, arg, values) triples) are
+    validated on their op-name element.  Returns the normalized names."""
+    from .ops import registry as _registry
+    names = []
+    for op in ops:
+        names.append(op if isinstance(op, str) else op[0])
+    known = set(_registry.list_ops())
+    unknown = sorted(n for n in names if n not in known)
+    if unknown:
+        raise ValueError(
+            "amp.init(%s=...): unknown op name(s) %s — not in the op "
+            "registry (mx.ops.registry.list_ops()); check spelling "
+            "against the reference op names (e.g. 'FullyConnected', "
+            "'softmax')" % (kwarg, unknown))
+    return names
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Turn on the global mixed-precision policy.  fp32_ops extends the
     f32-pinned set consumed by convert_symbol/convert_model;
     target_precision_ops restricts nothing here (every op not in FP32_OPS
-    already runs in the target dtype)."""
+    already runs in the target dtype).  All three op lists are validated
+    against the op registry — unknown names raise ValueError instead of
+    silently recoloring nothing."""
     target_dtype = jnp.bfloat16 if str(target_dtype) in (
         "bfloat16", "bf16") else _np.float16
+    # validate EVERY list before mutating any state, so a rejected call
+    # leaves the policy untouched (the knob-validator revert contract)
+    if target_precision_ops:
+        _validate_op_names("target_precision_ops", target_precision_ops)
+    fp32_names = _validate_op_names("fp32_ops", fp32_ops) \
+        if fp32_ops else ()
+    cond_names = _validate_op_names("conditional_fp32_ops",
+                                    conditional_fp32_ops) \
+        if conditional_fp32_ops else ()
     _STATE["initialized"] = True
     _STATE["target_dtype"] = target_dtype
-    if fp32_ops:
-        FP32_OPS.update(fp32_ops)
-    if conditional_fp32_ops:
-        FP32_OPS.update(op if isinstance(op, str) else op[0]
-                        for op in conditional_fp32_ops)
+    FP32_OPS.update(fp32_names)
+    FP32_OPS.update(cond_names)
 
 
 def active_dtype():

@@ -402,6 +402,40 @@ register_knob(
     "fork (opt-in: cheapest, but forking a live XLA runtime risks "
     "deadlock; reference dataloader.py:558 is likewise spawn-capable).")
 
+# INT8 post-training quantization (docs/QUANTIZATION.md)
+register_knob(
+    "quant.calib_mode", "MXNET_TPU_QUANT_CALIB_MODE", str, "entropy",
+    "default mx.quantization calibration mode: 'entropy' (KL-divergence "
+    "threshold search over activation histograms, clips outliers — the "
+    "reference's calib_mode='entropy') or 'naive' (observed |max|). "
+    "Degenerate histograms fall back to naive and count "
+    "quantization.calib_fallback.")
+register_knob(
+    "quant.calib_bins", "MXNET_TPU_QUANT_CALIB_BINS", int, 4001,
+    "histogram bins for entropy calibration (reference calibrate.cc uses "
+    "8001/4001-class histograms); more bins = finer KL threshold search, "
+    "slower calibration.")
+register_knob(
+    "quant.error_budget", "MXNET_TPU_QUANT_ERROR_BUDGET", float, 0.05,
+    "mx.quantization accuracy guardrail: max relative L2 error "
+    "(||int8 - fp32||/||fp32||, worst calibration batch) an "
+    "export_quantized artifact may show before the export REFUSES to "
+    "emit (QuantizationError). Raise only with model-level accuracy "
+    "evidence; exclude sensitive sites instead where possible.")
+
+
+def _apply_quant_calib_mode(value):
+    v = (value or "").strip().lower()
+    if v not in ("naive", "entropy"):
+        # reject at set() time and revert (the nanguard pattern) so a typo
+        # can't silently select an undefined calibration mode later
+        _OVERRIDES.pop("quant.calib_mode", None)
+        raise ValueError("quant.calib_mode must be 'naive' or 'entropy', "
+                         "got %r" % (value,))
+
+
+_ON_SET["quant.calib_mode"] = _apply_quant_calib_mode
+
 # inference serving (docs/SERVING.md)
 register_knob(
     "serving.max_batch", "MXNET_TPU_SERVING_MAX_BATCH", int, 32,

@@ -14,6 +14,13 @@ inside one pure op so int8 tensors never cross node boundaries and XLA keeps
 them on-chip.  Thresholds come from naive min/max or KL-divergence
 calibration over a calibration iterator — the same calib modes and workflow
 as the reference.
+
+This module is the SYMBOLIC-ERA surface.  The deployment pipeline
+(calibration runner -> int8-recolored StableHLO export -> quantized
+serving) lives in ``mx.quantization`` (mxnet_tpu/quantization.py) and
+reuses the calibration core here (``calib_thresholds``/``_kl_threshold``);
+``quantize_model`` below is kept as a thin legacy shim over that shared
+backend.  docs/QUANTIZATION.md.
 """
 from __future__ import annotations
 
@@ -41,11 +48,32 @@ def dequantize(q, amax):
 
 # --------------------------------------------------------------- calibration
 
+def _calib_fallback(reason):
+    """Count a degenerate-histogram fallback to the naive amax
+    (quantization.calib_fallback[.<reason>]) — the KL search has no
+    meaningful distribution to optimize over."""
+    from .. import telemetry as _telemetry
+    _telemetry.counter("quantization.calib_fallback").inc()
+    _telemetry.counter("quantization.calib_fallback.%s" % reason).inc()
+
+
 def _kl_threshold(hist, edges, num_quantized_bins=255):
     """KL-divergence threshold search (reference: calibrate.cc entropy
     mode): pick the clip range minimizing KL(P||Q) between the f32
-    histogram P and its int8-requantized image Q."""
+    histogram P and its int8-requantized image Q.
+
+    Degenerate inputs — an all-zero histogram (no observed mass) or a
+    single-bin distribution (a constant activation) — have no KL
+    landscape to search: they return the naive amax (``edges[-1]``)
+    directly and count a ``quantization.calib_fallback`` telemetry
+    counter instead of risking divide-by-zero / arbitrary thresholds."""
     hist = hist.astype(_np.float64)
+    if hist.sum() == 0:
+        _calib_fallback("all_zero")
+        return float(edges[-1])
+    if (hist > 0).sum() <= 1:
+        _calib_fallback("single_bin")
+        return float(edges[-1])
     n = len(hist)
     best_kl, best_t = _np.inf, edges[-1]
     # scan candidate clip points from 1/8 of the range up
@@ -86,6 +114,10 @@ def calib_thresholds(activations, mode="entropy", num_bins=4001):
     out = {}
     for name, arr in activations.items():
         a = _np.abs(_np.asarray(arr).ravel())
+        # non-finite samples (a NaN-poisoned calibration batch) would
+        # crash np.histogram / pin amax to inf — drop them first
+        if a.size and not _np.isfinite(a).all():
+            a = a[_np.isfinite(a)]
         if mode == "naive" or a.size == 0:
             out[name] = float(a.max()) if a.size else 1.0
             continue
@@ -153,7 +185,17 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     """The reference's one-call PTQ driver (contrib/quantization.py:443):
     collect activations over calib_data, compute thresholds, return
     (quantized symbol, params).  With calib_mode='none', only weights get
-    quantized (dynamic activation range at runtime)."""
+    quantized (dynamic activation range at runtime).
+
+    .. deprecated::
+        This is the LEGACY symbolic shim, kept with its original return
+        contract for existing Module callers.  New code should use the
+        deployment-grade backend this wraps — ``mx.quantization``:
+        ``calibrate()`` + ``export_quantized()`` produce an int8-recolored
+        StableHLO artifact (deploy format v3) that ``mx.serving`` AOT-
+        compiles per pad bucket (docs/QUANTIZATION.md).  Both paths share
+        the same calibration core (``calib_thresholds``/``_kl_threshold``
+        below)."""
     from ..symbol.symbol import _topo
 
     thresholds = {}

@@ -26,6 +26,16 @@ Format history (``meta["format_version"]``):
       serves every request size — the enabler for ``mx.serving``'s
       bucketed continuous batching.  v1 artifacts still load (the missing
       fields default to fixed-batch semantics).
+  v3  QUANTIZED artifacts (written by ``mx.quantization.export_quantized``
+      only; fp32 exports stay v2): the program is int8-recolored
+      (int8 dot_general/conv with int32 accumulation), the params .npz
+      holds REAL int8 weight payloads plus ``<name>::scale`` per-channel
+      scales, and meta.json carries ``quantized: true`` + the calibration
+      manifest.  v1/v2 artifacts keep loading unchanged; a v3 artifact
+      REFUSES the fp32 load path (``load_model(prefix)``) with a clear
+      error — load it with ``load_model(prefix, quantized=True)`` /
+      ``serving.Server.register(..., quantized=True)`` so a caller can
+      never serve int8 numerics believing they are fp32.
 """
 from __future__ import annotations
 
@@ -38,6 +48,13 @@ __all__ = ["export_model", "load_model", "StableHLOPredictor",
            "FORMAT_VERSION"]
 
 FORMAT_VERSION = 2
+
+#: format version stamped by ``mx.quantization.export_quantized``
+QUANTIZED_FORMAT_VERSION = 3
+
+#: newest format this build can load; future versions error clearly
+#: instead of misinterpreting fields
+MAX_SUPPORTED_FORMAT = 3
 
 
 def _shape_signature(aval):
@@ -145,7 +162,7 @@ class StableHLOPredictor:
     same request shape replay a compiled program instead of re-tracing.
     """
 
-    def __init__(self, prefix):
+    def __init__(self, prefix, quantized=False):
         import jax
         from jax import export as jexport
         from . import io as _io
@@ -154,6 +171,27 @@ class StableHLOPredictor:
         with open(prefix + "-meta.json") as f:
             self.meta = json.load(f)
         self.format_version = int(self.meta.get("format_version", 1))
+        if self.format_version > MAX_SUPPORTED_FORMAT:
+            raise ValueError(
+                "artifact %r is deploy format v%d, newer than this "
+                "build's v%d — upgrade before loading"
+                % (prefix, self.format_version, MAX_SUPPORTED_FORMAT))
+        self.quantized = bool(self.meta.get("quantized", False))
+        if self.quantized and not quantized:
+            raise ValueError(
+                "artifact %r is a QUANTIZED (format v%d) program: its "
+                "params are int8 payloads and its outputs carry int8 "
+                "numerics — the fp32 load path refuses it rather than "
+                "silently dequantizing. Load it explicitly with "
+                "deploy.load_model(prefix, quantized=True) or "
+                "serving.Server.register(..., quantized=True)."
+                % (prefix, self.format_version))
+        if quantized and not self.quantized:
+            raise ValueError(
+                "artifact %r was loaded with quantized=True but is a "
+                "plain fp32 export (format v%d, no quantized params); "
+                "export it with mx.quantization.export_quantized or drop "
+                "the flag" % (prefix, self.format_version))
         self.dynamic_batch = bool(self.meta.get("dynamic_batch", False))
         params_path = prefix + "-params.npz"
         self._params = None
@@ -221,5 +259,8 @@ class StableHLOPredictor:
         return self.predict(data)
 
 
-def load_model(prefix):
-    return StableHLOPredictor(prefix)
+def load_model(prefix, quantized=False):
+    """Reload an exported artifact.  ``quantized=True`` is REQUIRED for
+    v3 quantized artifacts (and rejected for fp32 ones) — the flag is the
+    caller's acknowledgement that outputs carry int8 numerics."""
+    return StableHLOPredictor(prefix, quantized=quantized)

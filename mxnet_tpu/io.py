@@ -389,6 +389,10 @@ def _stage_put(x, sharding, source):
                else jax.device_put(x))
     _telemetry.timer("io.h2d_ms").observe((_time.perf_counter() - t0) * 1e3)
     _telemetry.counter("io.staged_bytes").inc(nbytes)
+    if str(getattr(x, "dtype", "")) == "int8":
+        # quantized payloads (deploy format v3 int8 weights) — lets the
+        # serving dashboards attribute upload volume to int8 vs fp32
+        _telemetry.counter("io.staged_int8_bytes").inc(nbytes)
     return out
 
 

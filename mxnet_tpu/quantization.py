@@ -1,0 +1,623 @@
+"""``mx.quantization`` — TPU-native INT8 post-training quantization over
+the StableHLO export path.
+
+Reference: python/mxnet/contrib/quantization.py (`quantize_model` driving
+the C++ quantize graph pass + calibrate.cc KL histograms + int8 kernels).
+The symbolic-era port of that API lives on in
+:mod:`mxnet_tpu.contrib.quantization` as a thin legacy shim; THIS module is
+the deployment-grade pipeline the serving stack uses:
+
+  calibrate(block, batches)          # observed per-tensor |max| ranges
+      -> Calibration                 #   (naive min/max or entropy KL)
+  export_quantized(block, prefix,    # int8-recolored StableHLO program +
+                   calibration)      #   int8 params + scales (format v3)
+  deploy.load_model(prefix, quantized=True)
+  serving.Server.register(name, prefix, quantized=True)
+
+Design (ROADMAP item 2, SURVEY §quantization):
+
+  * **Calibration runner** — representative batches run through the
+    HybridBlock eagerly while the ``FullyConnected``/``Convolution``
+    registry ops are wrapped with a RECORDING shim: each quantizable call
+    site gets a stable name (``FullyConnected_0``, ``Convolution_1`` ... in
+    execution order), its activation |max| samples feed the shared
+    ``contrib.quantization.calib_thresholds`` (naive or entropy mode, the
+    reference's calib_mode values), and the observed ranges land on the
+    telemetry registry (``quantization.amax.<site>`` gauges,
+    ``quantization.calib_batches``/``calib_tensors`` counters).  The
+    result is a :class:`Calibration` manifest (JSON-serializable).
+  * **Quantize transform** — the same two ops are swapped for RECOLORING
+    shims while the inference function is traced for ``jax.export``: data
+    is quantized symmetrically per-tensor at the calibrated amax, weights
+    per OUTPUT CHANNEL, the contraction runs as int8 ``lax.dot_general`` /
+    ``conv_general_dilated`` with int32 accumulation (the MXU's native
+    int8 path) and the f32 dequant epilogue is left for XLA to fuse.
+    Sites can be excluded by name or by op type; an ACCURACY GUARDRAIL
+    compares quantized vs fp32 outputs over the calibration set and
+    refuses to emit an artifact whose relative error exceeds the
+    ``quant.error_budget`` knob (:class:`QuantizationError`).
+  * **Deploy format v3** — ``{prefix}-params.npz`` stores the quantized
+    weights as REAL int8 payloads plus ``<name>::scale`` per-channel f32
+    scales (the artifact is ~4x smaller where it counts); the calibration
+    manifest + measured error ride in ``{prefix}-meta.json``
+    (``format_version: 3``, ``quantized: true``).  v1/v2 artifacts keep
+    loading through :class:`~mxnet_tpu.deploy.StableHLOPredictor`; a v3
+    artifact refuses the fp32 load path with a clear error.
+  * **Quantized serving** — the exported program keeps the v2 symbolic
+    batch dim, so ``mx.serving`` AOT-compiles it once per pad bucket
+    exactly like an fp32 model (``serving.compiles`` stays flat under
+    ragged traffic) and the persistent compile cache applies unchanged.
+
+Knobs (config.py): ``quant.calib_mode`` (MXNET_TPU_QUANT_CALIB_MODE),
+``quant.calib_bins`` (MXNET_TPU_QUANT_CALIB_BINS), ``quant.error_budget``
+(MXNET_TPU_QUANT_ERROR_BUDGET).  docs/QUANTIZATION.md has the walkthrough;
+``tools/check_quantization.py`` is the <5s CPU end-to-end smoke.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import config as _config
+from . import telemetry as _telemetry
+
+__all__ = ["Calibration", "QuantizationError", "calibrate",
+           "export_quantized", "quantized_error", "load_quantized",
+           "QUANTIZABLE_OPS", "SCALE_SUFFIX"]
+
+#: op types the recolor transform understands (the matmul-heavy set whose
+#: int8 path the MXU accelerates; reference QUANTIZABLE_OPS)
+QUANTIZABLE_OPS = ("FullyConnected", "Convolution")
+
+#: npz/meta key suffix for a quantized weight's per-channel scale array
+SCALE_SUFFIX = "::scale"
+
+#: per-site cap on stored |activation| samples per calibration batch —
+#: bounds calibration memory on big batches without biasing the histogram
+#: (strided subsample, not truncation)
+_MAX_SAMPLES_PER_BATCH = 1 << 16
+
+# the registry-op patch swaps shared Operator.fn slots: one transform at a
+# time process-wide (calibration/export are host-side driver steps, never
+# on the serving hot path)
+_PATCH_LOCK = threading.RLock()
+
+
+class QuantizationError(RuntimeError):
+    """Raised when the quantize transform refuses to emit: the quantized
+    outputs diverged from fp32 past the configured error budget, or the
+    calibration manifest does not cover the model."""
+
+
+# ------------------------------------------------------------ int8 helpers
+
+def _to_int8_per_tensor(x, amax):
+    """Symmetric per-tensor int8: returns (q int8, scale f32 scalar)."""
+    s = 127.0 / jnp.maximum(jnp.asarray(amax, jnp.float32), 1e-12)
+    q = jnp.clip(jnp.round(x * s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _to_int8_per_channel(w, channel_axis=0):
+    """Symmetric per-OUTPUT-CHANNEL int8 weight quantization: returns
+    (q int8, scale f32 with singleton non-channel dims).  Per-channel
+    scales are what keep conv/FC accuracy inside the budget when channel
+    magnitudes differ by orders of magnitude (reference MKLDNN
+    channel-wise weight scales)."""
+    w = jnp.asarray(w)
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    s = 127.0 / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(w * s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def quantize_weight_host(w):
+    """Host-side per-channel weight quantization for the v3 artifact:
+    returns ``(q int8 ndarray, scale f32 ndarray)`` with
+    ``q.astype(f32) * scale ~= w`` (scale carries singleton non-channel
+    dims so the dequant is a plain broadcast multiply)."""
+    w = _np.asarray(w, _np.float32)
+    axes = tuple(range(1, w.ndim))
+    amax = _np.max(_np.abs(w), axis=axes, keepdims=True) if axes \
+        else _np.abs(w)
+    scale = _np.maximum(amax, 1e-12) / 127.0
+    q = _np.clip(_np.round(w / scale), -127, 127).astype(_np.int8)
+    return q, scale.astype(_np.float32)
+
+
+# --------------------------------------------------------- recolored ops
+
+def _q_fully_connected(data, weight, bias=None, amax_data=0.0,
+                       num_hidden=None, no_bias=False, flatten=True, **_):
+    """int8 FullyConnected: per-tensor data scale (calibrated amax; <= 0
+    falls back to the tensor's runtime range), per-channel weight scales,
+    int8xint8->int32 ``lax.dot_general`` on the MXU, f32 dequant epilogue
+    (XLA fuses it into the consumer)."""
+    x = jnp.asarray(data)
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    amax = jnp.asarray(amax_data, jnp.float32)
+    amax = jnp.where(amax > 0, amax, jnp.max(jnp.abs(x)))
+    xq, sx = _to_int8_per_tensor(x, amax)
+    wq, sw = _to_int8_per_channel(jnp.asarray(weight), channel_axis=0)
+    acc = lax.dot_general(xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    # sw is (O, 1); the output's channel dim is LAST
+    out = acc.astype(jnp.float32) / (sx * sw[:, 0])
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def _q_convolution(data, weight, bias=None, amax_data=0.0, kernel=None,
+                   stride=None, dilate=None, pad=None, num_filter=None,
+                   num_group=1, no_bias=False, layout=None, **_):
+    """int8 Convolution with s32 accumulation and per-channel weight
+    scales.  Always lowers with the native NC-first dimension numbers —
+    the NHWC internal-layout experiment (conv.internal_layout) is an fp32
+    training knob and is deliberately not composed with the int8 path."""
+    from .ops.nn import _tup, _conv_dims
+    x = jnp.asarray(data)
+    w = jnp.asarray(weight)
+    ndim = x.ndim - 2
+    stride = _tup(stride, ndim)
+    dilate = _tup(dilate, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    pad = pad if isinstance(pad[0], tuple) else tuple((p, p) for p in pad)
+    amax = jnp.asarray(amax_data, jnp.float32)
+    amax = jnp.where(amax > 0, amax, jnp.max(jnp.abs(x)))
+    xq, sx = _to_int8_per_tensor(x, amax)
+    wq, sw = _to_int8_per_channel(w, channel_axis=0)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(ndim))
+    acc = lax.conv_general_dilated(
+        xq, wq, window_strides=stride, padding=pad, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    # sw is (O, 1, ..., 1); output channels ride axis 1
+    out = acc.astype(jnp.float32) / (sx * sw.reshape((1, -1) + (1,) * ndim))
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+_RECOLOR_FN = {"FullyConnected": _q_fully_connected,
+               "Convolution": _q_convolution}
+
+
+# ---------------------------------------------------------- op patching
+
+class _SitePlan:
+    """Shared mutable state for one calibration or recolor pass: the
+    execution-order site counter plus per-site records."""
+
+    def __init__(self):
+        self.index = 0
+        self.records = []        # calibration: per-call dicts
+        self.sites_hit = []      # recolor: site names actually recolored
+
+    def begin_forward(self):
+        self.index = 0
+
+    def next_site(self, op):
+        name = "%s_%d" % (op, self.index)
+        self.index += 1
+        return name
+
+
+class _patched_ops:
+    """Context manager swapping the FullyConnected/Convolution registry
+    ``Operator.fn`` slots for ``wrapper(site_name, orig_fn, *args,
+    **attrs)`` shims.  Aliases share the Operator object, so one swap
+    covers every dispatch route (nd, npx, hybridized forward).  Guarded by
+    a process lock — transforms are driver-side, one at a time."""
+
+    def __init__(self, plan, make_wrapper):
+        self._plan = plan
+        self._make = make_wrapper
+        self._saved = {}
+
+    def __enter__(self):
+        from .ops import registry as _registry
+        _PATCH_LOCK.acquire()
+        try:
+            for op_name in QUANTIZABLE_OPS:
+                op = _registry.get(op_name)
+                self._saved[op_name] = (op, op.fn)
+                op.fn = self._make(op_name, op.fn)
+        except BaseException:
+            self._restore()
+            _PATCH_LOCK.release()
+            raise
+        return self._plan
+
+    def __exit__(self, *exc):
+        self._restore()
+        _PATCH_LOCK.release()
+        return False
+
+    def _restore(self):
+        for op, fn in self._saved.values():
+            op.fn = fn
+        self._saved.clear()
+
+
+def _recording_patch(plan, weight_names):
+    """Calibration-mode wrappers: run the ORIGINAL f32 op, but record the
+    site's activation |max| samples and which parameter fed its weight."""
+
+    def make(op_name, orig_fn):
+        def recorded(data, weight, *args, **attrs):
+            site = plan.next_site(op_name)
+            x = _np.asarray(data)
+            flat = _np.abs(x.ravel())
+            if flat.size > _MAX_SAMPLES_PER_BATCH:
+                flat = flat[::flat.size // _MAX_SAMPLES_PER_BATCH + 1]
+            plan.records.append({
+                "site": site, "op": op_name,
+                "weight": weight_names.get(id(weight)),
+                "samples": flat,
+            })
+            return orig_fn(data, weight, *args, **attrs)
+        return recorded
+
+    return make
+
+
+def _recolor_patch(plan, thresholds, excluded):
+    """Recolor-mode wrappers: quantizable sites not excluded (by site name
+    or op type) execute the int8 shim at their calibrated amax; everything
+    else falls through to the f32 original."""
+
+    def make(op_name, orig_fn):
+        qfn = _RECOLOR_FN[op_name]
+
+        def recolored(data, weight, *args, **attrs):
+            site = plan.next_site(op_name)
+            if site in excluded or op_name in excluded \
+                    or site not in thresholds:
+                return orig_fn(data, weight, *args, **attrs)
+            plan.sites_hit.append(site)
+            attrs.pop("amax_data", None)
+            return qfn(data, weight, *args,
+                       amax_data=float(thresholds[site]), **attrs)
+        return recolored
+
+    return make
+
+
+# ------------------------------------------------------------ calibration
+
+class Calibration:
+    """The calibration manifest: per-site activation thresholds plus the
+    site -> weight-parameter map and provenance (mode, batch/sample
+    counts).  JSON round-trips via :meth:`to_dict`/:meth:`from_dict` (the
+    exported artifact embeds it in meta.json); :meth:`save`/:meth:`load`
+    write it standalone so one calibration run can feed many exports."""
+
+    def __init__(self, mode, thresholds, sites, num_batches, num_samples,
+                 batches=None):
+        self.mode = mode
+        self.thresholds = dict(thresholds)    # site -> activation amax
+        self.sites = list(sites)              # [{name, op, weight}]
+        self.num_batches = int(num_batches)
+        self.num_samples = int(num_samples)
+        # calibration inputs retained for the accuracy guardrail (host
+        # arrays; not serialized)
+        self.batches = list(batches) if batches is not None else []
+
+    def to_dict(self):
+        return {"mode": self.mode,
+                "thresholds": {k: float(v)
+                               for k, v in self.thresholds.items()},
+                "sites": self.sites,
+                "num_batches": self.num_batches,
+                "num_samples": self.num_samples}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d.get("mode", "naive"), d.get("thresholds", {}),
+                   d.get("sites", []), d.get("num_batches", 0),
+                   d.get("num_samples", 0))
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        return path
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def __repr__(self):
+        return ("Calibration(mode=%r, sites=%d, batches=%d, samples=%d)"
+                % (self.mode, len(self.sites), self.num_batches,
+                   self.num_samples))
+
+
+def _as_host_batches(batches):
+    """Normalize the calibration input: a DataIter, an iterable of arrays,
+    or a single array -> list of host np.float32-family arrays."""
+    from .ndarray.ndarray import NDArray
+    from .io import DataIter
+    out = []
+    if isinstance(batches, DataIter):
+        batches.reset()
+        for b in batches:
+            out.append(_np.asarray(b.data[0].asnumpy()))
+        batches.reset()
+        return out
+    if isinstance(batches, (_np.ndarray, NDArray)) or hasattr(batches,
+                                                              "shape"):
+        batches = [batches]
+    for b in batches:
+        out.append(_np.asarray(b._data if isinstance(b, NDArray) else b))
+    return out
+
+
+def calibrate(block, batches, mode=None, bins=None):
+    """Run representative ``batches`` through ``block`` and return a
+    :class:`Calibration` manifest of per-site activation thresholds.
+
+    ``batches``: a DataIter, an iterable of input arrays, or one array.
+    ``mode``: 'naive' (observed |max|) or 'entropy' (KL threshold search,
+    the reference's calib modes) — default from the ``quant.calib_mode``
+    knob.  Observed ranges are published as ``quantization.amax.<site>``
+    gauges; degenerate KL histograms fall back to naive and count
+    ``quantization.calib_fallback`` (see contrib.quantization).
+    """
+    from . import tracing as _tracing
+    from .contrib.quantization import calib_thresholds
+    from .parallel.functional import functionalize
+
+    if mode is None:
+        mode = _config.get("quant.calib_mode")
+    mode = str(mode).strip().lower()
+    if mode not in ("naive", "entropy"):
+        raise ValueError("calibration mode must be 'naive' or 'entropy', "
+                         "got %r" % (mode,))
+    if bins is None:
+        bins = _config.get("quant.calib_bins")
+
+    host_batches = _as_host_batches(batches)
+    if not host_batches:
+        raise ValueError("calibrate() needs at least one batch")
+
+    # resolve deferred shapes before patching (lazy initialization must
+    # never run — or consume site indices — under the recording shim) and
+    # BEFORE functionalize, which snapshots collect_params()
+    from .ndarray.ndarray import _wrap
+    block(_wrap(jnp.asarray(host_batches[0])))
+    fn = functionalize(block)
+    weight_names = {id(v): n for n, v in fn.init_values().items()}
+
+    plan = _SitePlan()
+    acts = {}      # site -> [abs-sample arrays]
+    sites = {}     # site -> {name, op, weight}
+    n_samples = 0
+    with _tracing.span("quantization.calibrate", cat="quantization",
+                       mode=mode, batches=len(host_batches)):
+        with _patched_ops(plan, _recording_patch(plan, weight_names)):
+            for b in host_batches:
+                plan.begin_forward()
+                plan.records = []
+                block(_wrap(jnp.asarray(b)))
+                for rec in plan.records:
+                    acts.setdefault(rec["site"], []).append(rec["samples"])
+                    sites.setdefault(rec["site"], {
+                        "name": rec["site"], "op": rec["op"],
+                        "weight": rec["weight"]})
+                    n_samples += rec["samples"].size
+                _telemetry.counter("quantization.calib_batches").inc()
+    if not sites:
+        raise QuantizationError(
+            "no quantizable op (%s) executed in the block's forward — "
+            "nothing to calibrate" % (", ".join(QUANTIZABLE_OPS),))
+
+    merged = {k: _np.concatenate(v) for k, v in acts.items()}
+    thresholds = calib_thresholds(merged, mode=mode, num_bins=int(bins))
+    for site, amax in thresholds.items():
+        _telemetry.gauge("quantization.amax.%s" % site).set(float(amax))
+    _telemetry.counter("quantization.calib_tensors").inc(len(thresholds))
+    return Calibration(mode, thresholds,
+                       [sites[k] for k in sorted(sites)],
+                       len(host_batches), n_samples, batches=host_batches)
+
+
+# --------------------------------------------------------- the transform
+
+def _fp32_outputs(fn, values, batches):
+    outs = []
+    for b in batches:
+        (o,), _ = fn.apply(dict(values), (jnp.asarray(b),),
+                           key=jax.random.PRNGKey(0), training=False)
+        outs.append(_np.asarray(o))
+    return outs
+
+
+def quantized_error(block, calibration, excluded=(), batches=None):
+    """Measured relative error of the recolored block vs fp32 over the
+    calibration set: ``max_b ||q_b - f_b||2 / ||f_b||2``.  This is the
+    number the export guardrail checks against ``quant.error_budget``."""
+    from .parallel.functional import functionalize
+    from .ndarray.ndarray import _wrap
+    batches = calibration.batches if batches is None \
+        else _as_host_batches(batches)
+    if not batches:
+        raise ValueError("no batches to evaluate: pass batches= or use a "
+                         "Calibration produced by calibrate() in-process")
+    block(_wrap(jnp.asarray(batches[0])))  # resolve deferred shapes
+    fn = functionalize(block)
+    values = fn.init_values()
+    excluded = frozenset(excluded)
+    fp32 = _fp32_outputs(fn, values, batches)
+    worst = 0.0
+    plan = _SitePlan()
+    with _patched_ops(plan, _recolor_patch(plan, calibration.thresholds,
+                                           excluded)):
+        for b, f in zip(batches, fp32):
+            plan.begin_forward()
+            (q,), _ = fn.apply(dict(values), (jnp.asarray(b),),
+                               key=jax.random.PRNGKey(0), training=False)
+            q = _np.asarray(q)
+            denom = max(float(_np.linalg.norm(f)), 1e-12)
+            worst = max(worst, float(_np.linalg.norm(q - f)) / denom)
+    return worst
+
+
+def export_quantized(block, prefix, calibration, excluded=(),
+                     error_budget=None, dynamic_batch=True):
+    """Quantize ``block`` under ``calibration`` and export the int8
+    program + quantized params as a deploy FORMAT V3 artifact.
+
+    The inference function is re-traced with the quantizable sites
+    recolored to int8 (per-tensor activation scales from the calibration
+    manifest, per-channel weight scales); quantized weights ship as int8
+    arrays with ``<name>::scale`` companions in the params.npz, so the
+    artifact holds real int8 payloads.  ``excluded`` skips sites by name
+    (``"Convolution_0"``) or op type (``"Convolution"``).
+
+    Accuracy guardrail: the recolored function is evaluated against fp32
+    on the calibration set FIRST; if the relative error exceeds
+    ``error_budget`` (default: the ``quant.error_budget`` knob) nothing is
+    written and :class:`QuantizationError` is raised — an artifact that
+    fails its own calibration set must never reach serving.
+
+    Returns the list of written paths (model/meta/params).
+    """
+    from jax import export as jexport
+    from . import deploy as _deploy
+    from . import tracing as _tracing
+    from .parallel.functional import functionalize
+    from .ndarray.ndarray import _wrap
+
+    if error_budget is None:
+        error_budget = _config.get("quant.error_budget")
+    error_budget = float(error_budget)
+    excluded = frozenset(excluded)
+    if not calibration.batches:
+        raise QuantizationError(
+            "calibration manifest carries no batches for the accuracy "
+            "guardrail; produce it with calibrate() in-process")
+
+    measured = quantized_error(block, calibration, excluded=excluded)
+    if measured > error_budget:
+        _telemetry.counter("quantization.guardrail_rejects").inc()
+        raise QuantizationError(
+            "quantized outputs diverged from fp32 by %.4f relative error "
+            "on the calibration set, past the %.4f budget "
+            "(quant.error_budget); refusing to emit. Raise the budget, "
+            "exclude sensitive sites (excluded=...), or recalibrate with "
+            "mode='entropy'." % (measured, error_budget))
+
+    data0 = jnp.asarray(calibration.batches[0])
+    block(_wrap(data0))  # resolve deferred shapes outside the patch
+    fn = functionalize(block)
+    names = list(fn.params)
+    values = {n: jnp.asarray(v) for n, v in fn.init_values().items()}
+
+    # host-side weight quantization: the site -> weight map from the
+    # calibration run decides which params ship as int8 payloads
+    qweights = {}
+    for site in calibration.sites:
+        wname = site.get("weight")
+        if wname is None or wname in qweights:
+            continue
+        sname = site["name"]
+        if sname in excluded or site["op"] in excluded:
+            continue
+        q, scale = quantize_weight_host(values[wname])
+        qweights[wname] = (q, scale)
+    qnames = [n for n in names if n in qweights]
+    scale_names = [n + SCALE_SUFFIX for n in qnames]
+
+    thresholds = dict(calibration.thresholds)
+
+    def infer_q(params, x):
+        base = params[:len(names)]
+        scales = dict(zip(scale_names, params[len(names):]))
+        param_map = {}
+        for n, v in zip(names, base):
+            if n in qweights:
+                # dequantized view; the recolor shim re-derives the exact
+                # int8 grid (round() snaps the f32 roundtrip back), so the
+                # program's dot_general consumes the shipped int8 payload
+                v = v.astype(jnp.float32) * scales[n + SCALE_SUFFIX]
+            param_map[n] = v
+        plan = _SitePlan()
+        with _patched_ops(plan, _recolor_patch(plan, thresholds,
+                                               excluded)):
+            (out,), _ = fn.apply(param_map, (x,),
+                                 key=jax.random.PRNGKey(0),
+                                 training=False)
+        return out
+
+    arg_values = [qweights[n][0] if n in qweights else values[n]
+                  for n in names]
+    arg_values += [qweights[n][1] for n in qnames]
+    jitted = jax.jit(infer_q)
+    param_spec = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for v in arg_values)
+    exp = None
+    exported_dynamic = False
+    with _tracing.span("quantization.export", cat="quantization",
+                       sites=len(calibration.sites)):
+        if dynamic_batch and len(data0.shape) >= 1:
+            try:
+                b = jexport.symbolic_shape("b")[0]
+                spec = (param_spec,
+                        jax.ShapeDtypeStruct((b,) + tuple(data0.shape[1:]),
+                                             data0.dtype))
+                exp = jexport.export(jitted)(*spec)
+                exported_dynamic = True
+            except Exception:  # noqa: BLE001 — model constrains batch dim
+                exp = None
+        if exp is None:
+            spec = (param_spec,
+                    jax.ShapeDtypeStruct(data0.shape, data0.dtype))
+            exp = jexport.export(jitted)(*spec)
+    out_aval = exp.out_avals[0]
+    paths = []
+    hlo_path = prefix + "-model.stablehlo"
+    with open(hlo_path, "wb") as f:
+        f.write(exp.serialize())
+    paths.append(hlo_path)
+    meta = {
+        "param_names": names + scale_names,
+        "input_shape": list(data0.shape),
+        "input_dtype": str(data0.dtype),
+        "output_shape": _deploy._shape_signature(out_aval),
+        "output_dtype": str(out_aval.dtype),
+        "dynamic_batch": exported_dynamic,
+        "format_version": _deploy.QUANTIZED_FORMAT_VERSION,
+        "quantized": True,
+        "quantized_params": qnames,
+        "excluded": sorted(excluded),
+        "measured_error": round(measured, 6),
+        "error_budget": error_budget,
+        "calibration": calibration.to_dict(),
+    }
+    meta_path = prefix + "-meta.json"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    paths.append(meta_path)
+    params_path = prefix + "-params.npz"
+    _np.savez(params_path, **{n: _np.asarray(v)
+                              for n, v in zip(names + scale_names,
+                                              arg_values)})
+    paths.append(params_path)
+    _telemetry.counter("quantization.exports").inc()
+    return paths
+
+
+def load_quantized(prefix):
+    """Reload a v3 quantized artifact (the ``deploy.load_model(prefix,
+    quantized=True)`` convenience)."""
+    from . import deploy as _deploy
+    return _deploy.load_model(prefix, quantized=True)

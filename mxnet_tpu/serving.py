@@ -64,6 +64,12 @@ Key properties:
   * **Multi-model** — a bounded LRU table of registered models; the least
     recently used model (programs + device params) is evicted when
     ``max_models`` is exceeded.
+  * **Quantized models** — ``register(name, prefix, quantized=True)``
+    serves an int8 deploy-v3 artifact (``mx.quantization``): int8 params
+    stage once, the int8 program AOT-compiles per bucket exactly like
+    fp32 (compiles stay flat), ``serving.quantized_dispatches`` counts
+    its batches and the ``quantized`` flag rides ``stats()`` and every
+    per-dispatch JSONL record (docs/QUANTIZATION.md).
   * **Telemetry** — ``serving.requests`` / ``serving.batch_dispatches`` /
     ``serving.compiles`` / ``serving.shed_requests[.model]`` /
     ``serving.deadline_exceeded[.model]`` / ``serving.breaker_open
@@ -284,12 +290,13 @@ class _ModelEntry:
 
     __slots__ = ("name", "prefix", "predictor", "buckets", "programs",
                  "item_shape", "in_dtype", "breaker", "shed",
-                 "deadline_exceeded")
+                 "deadline_exceeded", "quantized")
 
     def __init__(self, name, prefix, predictor, buckets):
         self.name = name
         self.prefix = prefix
         self.predictor = predictor
+        self.quantized = bool(getattr(predictor, "quantized", False))
         self.buckets = tuple(buckets)
         self.programs = {}
         shape = predictor.meta.get("input_shape") or []
@@ -408,16 +415,25 @@ class Server:
         # 'off' (natural shapes) degenerates to the single full bucket
         return sizes or (cap,)
 
-    def register(self, name, prefix):
+    def register(self, name, prefix, quantized=False):
         """Load the ``mx.deploy`` artifact at ``prefix`` under ``name``:
         params go device-resident now; bucket programs compile now if the
         server is already started (else at :meth:`start`).  Re-registering
         a name replaces the entry (and resets its breaker).  The table is
         LRU-bounded at ``max_models`` — registering past it evicts the
         least recently used model (its programs and device params become
-        collectable)."""
+        collectable).
+
+        ``quantized=True`` registers an int8 (deploy format v3) artifact
+        written by ``mx.quantization.export_quantized``: its int8 bucket
+        programs AOT-compile exactly like fp32 ones (``serving.compiles``
+        stays == bucket count under ragged traffic, persistent compile
+        cache included) and the model is flagged ``quantized`` in
+        :meth:`stats` and every per-dispatch JSONL record.  The flag must
+        match the artifact — a v3 artifact without it (or an fp32
+        artifact with it) raises, so int8 numerics are always explicit."""
         from . import deploy as _deploy
-        predictor = _deploy.StableHLOPredictor(prefix)
+        predictor = _deploy.StableHLOPredictor(prefix, quantized=quantized)
         if predictor._params is None:
             raise ServingError(
                 "model %r: artifact %r was exported with "
@@ -967,6 +983,8 @@ class Server:
             _telemetry.timer("serving.request_ms").observe(
                 (t1 - req.t_submit) * 1e3)
         _telemetry.counter("serving.batch_dispatches").inc()
+        if entry.quantized:
+            _telemetry.counter("serving.quantized_dispatches").inc()
         _telemetry.timer("serving.batch_fill").observe(rows / bucket)
         _telemetry.timer("serving.dispatch_ms").observe((t1 - t0) * 1e3)
         self._last_dispatch_done = t1
@@ -976,7 +994,7 @@ class Server:
         if _telemetry.enabled():
             _telemetry.log_event(
                 "serving", model=entry.name, requests=len(batch),
-                rows=rows, bucket=bucket,
+                rows=rows, bucket=bucket, quantized=entry.quantized,
                 fill=round(rows / bucket, 4),
                 queue_delay_ms=round(max(
                     (t0 - req.t_submit) * 1e3 for req in batch), 4),
@@ -1029,6 +1047,8 @@ class Server:
             breakers = {name: e.breaker.state if e.breaker is not None
                         else "closed"
                         for name, e in self._models.items()}
+            quantized = {name: e.quantized
+                         for name, e in self._models.items()}
             pending = len(self._pending)
             thread = self._thread
         return {
@@ -1039,6 +1059,7 @@ class Server:
             "timers": {k: v for k, v in snap["timers"].items()
                        if k.startswith("serving.")},
             "models": self.models(),
+            "quantized": quantized,
             "pending": pending,
             "breakers": breakers,
             "batcher_alive": bool(thread is not None and thread.is_alive()),

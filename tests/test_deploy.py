@@ -161,6 +161,54 @@ def test_v1_meta_loads_with_fixed_batch_semantics(tmp_path):
     assert pred.predict(x).shape == (2, 4)
 
 
+# ------------------------------------------------- format v3 compat gates
+
+def test_quantized_artifact_refuses_fp32_load_path(tmp_path):
+    """A v3 quantized artifact must never load through the fp32 path —
+    its outputs carry int8 numerics (S4: clear error, no silent
+    dequantize)."""
+    from mxnet_tpu import quantization
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    x = np.random.RandomState(9).randn(4, 6).astype(np.float32)
+    cal = quantization.calibrate(net, [x])
+    prefix = str(tmp_path / "q")
+    quantization.export_quantized(net, prefix, cal)
+    with pytest.raises(ValueError, match="QUANTIZED.*quantized=True"):
+        deploy.load_model(prefix)
+    # the explicit flag loads it, and the meta round-trips the manifest
+    pred = deploy.load_model(prefix, quantized=True)
+    assert pred.quantized and pred.format_version == 3
+    assert pred.meta["calibration"]["thresholds"]
+
+
+def test_fp32_artifact_rejects_quantized_flag(tmp_path):
+    prefix, _ = _export_small(tmp_path)
+    with pytest.raises(ValueError, match="plain fp32 export"):
+        deploy.load_model(prefix, quantized=True)
+
+
+def test_future_format_version_rejected(tmp_path):
+    prefix, _ = _export_small(tmp_path)
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    meta["format_version"] = deploy.MAX_SUPPORTED_FORMAT + 1
+    with open(prefix + "-meta.json", "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="newer than this build"):
+        deploy.load_model(prefix)
+
+
+def test_v2_artifact_loads_after_v3_exists(tmp_path):
+    """v1/v2 artifacts keep loading unchanged in a build that also writes
+    v3 — the backward-compat half of S4."""
+    prefix, x = _export_small(tmp_path)
+    pred = deploy.load_model(prefix)
+    assert pred.format_version == 2 and not pred.quantized
+    assert pred.predict(x).shape == (2, 4)
+
+
 def test_predict_validates_shape_and_dtype(tmp_path):
     prefix, x = _export_small(tmp_path, dynamic_batch=False)
     pred = deploy.load_model(prefix)

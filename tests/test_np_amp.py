@@ -115,3 +115,30 @@ def test_amp_fp16_skips_overflow_update():
     finally:
         mx.amp._STATE["initialized"] = False
         mx.amp._STATE["target_dtype"] = None
+
+
+def test_amp_init_validates_op_lists():
+    """Unknown op names in amp.init's op lists raise instead of silently
+    recoloring nothing (S3 — mirrors the config knob validators)."""
+    state0 = dict(mx.amp._STATE)
+    fp32_0 = set(mx.amp.FP32_OPS)
+    try:
+        with pytest.raises(ValueError, match="fp32_ops.*NotAnOp"):
+            mx.amp.init(fp32_ops=["NotAnOp"])
+        # a rejected call leaves the policy AND the f32 set untouched
+        assert dict(mx.amp._STATE) == state0
+        assert set(mx.amp.FP32_OPS) == fp32_0
+        with pytest.raises(ValueError, match="target_precision_ops"):
+            mx.amp.init(target_precision_ops=["nope"])
+        with pytest.raises(ValueError, match="conditional_fp32_ops"):
+            mx.amp.init(conditional_fp32_ops=[("bogus_op", "act", ["1"])])
+        # known names (plain and tuple forms) are accepted and applied
+        mx.amp.init(fp32_ops=["exp"],
+                    conditional_fp32_ops=[("FullyConnected", "x", ["1"])],
+                    target_precision_ops=["Convolution"])
+        assert "exp" in mx.amp.FP32_OPS
+        assert "FullyConnected" in mx.amp.FP32_OPS
+    finally:
+        mx.amp._STATE.update(state0)
+        mx.amp.FP32_OPS.clear()
+        mx.amp.FP32_OPS.update(fp32_0)
