@@ -814,7 +814,36 @@ def _summarize(runs):
     })
 
 
+def _lint_preflight():
+    """Refuse to burn a bench sweep on a tree with open mxlint findings
+    (docs/ANALYSIS.md): a tracer leak or an unguarded cross-thread write
+    discovered AFTER a multi-hour run invalidates the numbers it
+    produced.  Returns the findings text, or None when clean (a broken
+    preflight itself only warns — linting must never eat the bench)."""
+    import subprocess
+    mxlint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "mxlint.py")
+    try:
+        proc = subprocess.run([sys.executable, mxlint],
+                              capture_output=True, text=True, timeout=120)
+    except Exception as e:  # noqa: BLE001 — preflight is best-effort
+        print("bench: mxlint preflight skipped (%s)" % e, file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        return (proc.stdout.strip() or proc.stderr.strip())[-2000:]
+    return None
+
+
 def main():
+    findings = _lint_preflight()
+    if findings is not None:
+        print(json.dumps({
+            "metric": "resnet50_train_throughput", "value": 0,
+            "unit": "img/s", "vs_baseline": 0,
+            "error": "mxlint preflight failed — fix or baseline the "
+                     "findings (tools/mxlint.py):\n%s" % findings,
+        }), flush=True)
+        os._exit(2)
     if os.environ.get("MXTPU_BENCH_CPU"):
         # Smoke-test mode: pin to the host CPU backend via jax.config (the
         # JAX_PLATFORMS env var is force-overridden by the environment's

@@ -1,0 +1,96 @@
+"""mx.analysis — the framework-native static-analysis suite.
+
+Three AST-level pass families guard the invariants this codebase keeps
+re-learning by hand (docs/ANALYSIS.md):
+
+* ``jit`` (jit_purity.py) — host syncs, tracer branches, trace-time
+  impurity and donated-buffer reuse inside jitted code.
+* ``locks`` (lock_discipline.py) — the ``# guarded-by:`` convention
+  plus cross-thread write inference over every class that starts a
+  background thread.
+* ``drift`` (drift.py) — knob registry, env-var docs and telemetry
+  metric index kept honest in both directions.
+
+``run(root)`` executes every pass over a parsed ``walker.Repo``,
+applies inline ``# mxlint: disable=`` comments and the checked-in
+baseline (tools/mxlint_baseline.json), and returns a ``Report``.  The
+CLI wrapper is ``tools/mxlint.py``; CI runs it through
+``tools/check_analysis.py``.  Nothing in this package imports jax or
+the framework — a full-tree lint parses ~200 files in well under a
+second.
+"""
+from __future__ import annotations
+
+from . import drift, jit_purity, lock_discipline, walker
+from .walker import Baseline, Finding, Repo
+
+__all__ = ["run", "Report", "Repo", "Finding", "Baseline", "PASSES",
+           "walker", "jit_purity", "lock_discipline", "drift"]
+
+#: pass id -> module; order is the report order.
+PASSES = {
+    "jit": jit_purity,
+    "locks": lock_discipline,
+    "drift": drift,
+}
+
+
+class Report(object):
+    """The outcome of one lint run."""
+
+    def __init__(self, findings, expired, repo):
+        self.findings = findings        # every finding, incl. suppressed
+        self.expired = expired          # stale baseline entries
+        self.repo = repo
+
+    @property
+    def active(self):
+        """Findings that fail the lint: unsuppressed + expired baseline
+        entries + files the walker could not parse."""
+        out = [f for f in self.findings if not f.suppressed]
+        out.extend(self.expired)
+        return out
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self):
+        return not self.active and not self.repo.parse_errors
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "active": [f.to_dict() for f in self.active],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "parse_errors": list(self.repo.parse_errors),
+        }
+
+
+def run(root, passes=None, baseline=None, targets=walker.DEFAULT_TARGETS):
+    """Run the suite over the tree at ``root``.
+
+    ``passes``: iterable of pass ids (default: all).  ``baseline``: a
+    ``walker.Baseline``, a path to one, or None.
+    """
+    repo = Repo(root, targets=targets)
+    findings = []
+    for pass_id in (passes or PASSES):
+        findings.extend(PASSES[pass_id].run(repo))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # inline suppressions
+    for f in findings:
+        module = repo.by_relpath.get(f.path)
+        if module is None:
+            continue
+        rules = module.disabled_rules(f.line)
+        full = "%s.%s" % (f.pass_id, f.rule)
+        if any(r in ("all", f.pass_id, full) for r in rules):
+            f.suppressed = True
+            f.reason = "inline: %s" % module.comment_on(f.line)
+    # baseline suppressions
+    if isinstance(baseline, str):
+        baseline = Baseline.load(baseline)
+    expired = baseline.apply(findings) if baseline is not None else []
+    return Report(findings, expired, repo)

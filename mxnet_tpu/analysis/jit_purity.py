@@ -1,0 +1,580 @@
+"""jit-purity / tracer-leak pass (pass id: ``jit``).
+
+Finds every function that jax traces — ``jax.jit(fn)`` /
+``jax.jit(lambda ...)`` call sites, ``@jax.jit`` / ``@partial(jax.jit)``
+decorators (``lower().compile()`` operates on an already-jitted callable,
+so those sites are covered by the jit call that produced it) — and walks
+the call graph reachable from each traced body, following same-module
+closures and alias-resolved cross-module helpers (the nanguard fold in
+``resilience.py`` reached from the fused step builders, for example).
+
+Inside traced code it flags:
+
+* ``host-sync`` — forcing a traced value to the host: ``float()/int()/
+  bool()`` on a tainted value, ``.item()/.tolist()/.asnumpy()/
+  .block_until_ready()``, ``np.asarray/np.array``, ``jax.device_get``.
+  PR 6 found exactly one of these (a per-call ``jnp.asarray`` re-upload)
+  by hand; this pass finds the class mechanically.
+* ``tracer-branch`` — Python ``if``/``while`` on a tainted name.  Shape
+  /dtype peeks (``x.ndim``, ``x.shape``), ``is None`` tests, ``len()``
+  and ``isinstance()`` stay legal: they are static at trace time.
+* ``impure-time`` / ``impure-random`` / ``impure-print`` — host
+  side effects that bake a trace-time constant into the compiled
+  program (``time.*``, stdlib/numpy ``random.*``) or silently run once
+  per *compile* instead of once per *step*.  ``mxnet_tpu.random`` is
+  the framework's traced-key module and is exempt by alias resolution.
+* ``donated-reuse`` — reading a buffer after passing it to a dispatch
+  whose ``donate_argnums`` covers it (the buffer may already be
+  aliased-over on device).
+
+Taint model: every non-static parameter of a traced entry is a tracer;
+assignments propagate taint through local names; calls into resolvable
+helpers bind taint positionally onto the callee's parameters.  Closure
+constants captured from the builder scope are untainted, which is what
+keeps knob-driven ``if guard:`` trace-time specialization legal.
+"""
+from __future__ import annotations
+
+import ast
+
+from .walker import Finding, dotted_name
+
+PASS_ID = "jit"
+
+_HOST_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_METHODS = {"item", "tolist", "asnumpy", "block_until_ready"}
+_NUMPY_HOST_FUNCS = {"asarray", "array", "copy", "save", "savez"}
+_SAFE_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                      "itemsize", "nbytes"}
+_TIME_MODULES = {"time", "datetime"}
+_RANDOM_MODULES = {"random", "numpy.random"}
+_MAX_DEPTH = 5
+
+
+def _base_module(module, name):
+    """Resolve the root of a dotted callee to the real module it names
+    ("_np.asarray" -> "numpy", "_random.foo" -> "mxnet_tpu.random")."""
+    parts = name.split(".")
+    resolved = module.resolve_alias(parts[0]) or parts[0]
+    return ".".join([resolved] + parts[1:-1])
+
+
+class _Scope(object):
+    """Lexical chain of locally-defined functions, for resolving a Name
+    used as a jit argument or callee to its def."""
+
+    def __init__(self, parent=None):
+        self.parent = parent
+        self.defs = {}
+
+    def lookup(self, name):
+        s = self
+        while s is not None:
+            if name in s.defs:
+                return s.defs[name]
+            s = s.parent
+        return None
+
+
+def _collect_scopes(tree):
+    """node -> _Scope holding the functions defined in that scope."""
+    scopes = {}
+
+    def visit(node, scope, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.defs[child.name] = child
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                inner = _Scope(scope)
+                name = getattr(child, "name", "<lambda>")
+                q = qual + "." + name if qual else name
+                scopes[child] = (inner, q)
+                visit(child, inner, q)
+            else:
+                visit(child, scope, qual)
+
+    top = _Scope()
+    scopes[tree] = (top, "")
+    visit(tree, top, "")
+    return scopes
+
+
+def _is_jit_callee(module, func_node):
+    d = dotted_name(func_node)
+    if not d:
+        return False
+    if d == "jit":
+        src = module.from_imports.get("jit")
+        return bool(src and src[0].split(".")[0] == "jax")
+    if d.endswith(".jit"):
+        return _base_module(module, d) == "jax"
+    return False
+
+
+def _static_params(call):
+    """Parameter names/positions excluded from taint by static_argnums/
+    static_argnames on the jit call."""
+    nums, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    nums.add(elt.value)
+        elif kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str):
+                    names.add(elt.value)
+    return nums, names
+
+
+def _donated_positions(call):
+    out = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    out.add(elt.value)
+    return out
+
+
+def _param_names(fn):
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+#: calls whose results are static host facts even on traced arguments
+_STATIC_FNS = {"len", "isinstance", "type", "hasattr", "getattr", "id",
+               "repr", "str", "format"}
+
+
+def _concrete_tainted_uses(node, tainted):
+    """Name nodes from ``tainted`` used *as traced values* in ``node``.
+
+    Static host facts do not propagate taint: shape/dtype peeks
+    (``x.shape``, ``x.ndim``), ``len()``/``isinstance()``-class calls,
+    and identity/membership comparisons (``x is None``, ``name in env``
+    — dict-key membership over a pytree of tracers is a host-side
+    string test).  A method call taints through its receiver
+    (``x.astype(...)``, ``x.mean()``).
+    """
+    hits = []
+
+    def walk(node, safe):
+        if isinstance(node, ast.Name):
+            if not safe and node.id in tainted:
+                hits.append(node)
+            return
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            callee_safe = safe or d in _STATIC_FNS
+            for a in node.args:
+                walk(a, callee_safe)
+            for kw in node.keywords:
+                walk(kw.value, callee_safe)
+            if isinstance(node.func, ast.Attribute):
+                walk(node.func.value, safe)     # method receiver
+            return
+        if isinstance(node, ast.Attribute):
+            walk(node.value, safe or node.attr in _SAFE_STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Compare):
+            ops_safe = all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                           ast.NotIn))
+                           for op in node.ops)
+            walk(node.left, safe or ops_safe)
+            for c in node.comparators:
+                walk(c, safe or ops_safe)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, safe)
+
+    walk(node, False)
+    return hits
+
+
+class _TracedWalker(ast.NodeVisitor):
+    """Walks one traced function body with a taint set of local names."""
+
+    def __init__(self, analysis, module, fn, qual, tainted, depth):
+        self.an = analysis
+        self.module = module
+        self.fn = fn
+        self.qual = qual
+        self.tainted = set(tainted)
+        self.depth = depth
+
+    # ------------------------------------------------------------ taint
+    def _expr_tainted(self, node):
+        if node is None:
+            return False
+        return bool(_concrete_tainted_uses(node, self.tainted))
+
+    def _assign_targets(self, target, tainted):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_targets(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_targets(target.value, tainted)
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        t = self._expr_tainted(node.value)
+        for target in node.targets:
+            self._assign_targets(target, t)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._assign_targets(node.target,
+                                 self._expr_tainted(node.value))
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        if self._expr_tainted(node.value) and \
+                isinstance(node.target, ast.Name):
+            self.tainted.add(node.target.id)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self._bind_loop_target(node.target, node.iter)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _bind_loop_target(self, target, it):
+        """Per-element taint for zip/enumerate/.items() iteration, so a
+        loop over (static_name, traced_value) pairs does not taint the
+        name — branching on dict keys stays legal."""
+        srcs = None
+        if isinstance(it, ast.Call):
+            d = dotted_name(it.func)
+            if d == "zip":
+                srcs = list(it.args)
+            elif d == "enumerate" and it.args:
+                srcs = [None] + list(it.args)
+            elif isinstance(it.func, ast.Attribute) and not it.args:
+                if it.func.attr == "items":
+                    srcs = [None, it.func.value]
+                elif it.func.attr == "keys":
+                    srcs = [None]
+        if srcs is not None and isinstance(target, ast.Tuple) and \
+                len(target.elts) == len(srcs):
+            for t, s in zip(target.elts, srcs):
+                self._assign_targets(
+                    t, s is not None and self._expr_tainted(s))
+            return
+        if srcs is not None and len(srcs) == 1 and \
+                isinstance(target, ast.Name):
+            self._assign_targets(target, srcs[0] is not None and
+                                 self._expr_tainted(srcs[0]))
+            return
+        self._assign_targets(target, self._expr_tainted(it))
+
+    def visit_withitem(self, node):
+        self.visit(node.context_expr)
+        if node.optional_vars is not None:
+            self._assign_targets(node.optional_vars,
+                                 self._expr_tainted(node.context_expr))
+
+    # ----------------------------------------------------- control flow
+    def _check_branch(self, node, kind):
+        for name in _concrete_tainted_uses(node.test, self.tainted):
+            self.an.emit(self.module, name.lineno, "tracer-branch",
+                         self.qual, name.id,
+                         "Python %s on traced value %r inside jitted "
+                         "code — the branch runs at trace time, not per "
+                         "step (use lax.cond/jnp.where or mark the "
+                         "argument static)" % (kind, name.id))
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node, "conditional expression")
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------ calls
+    def visit_Call(self, node):
+        self._check_call(node)
+        self.generic_visit(node)
+
+    def _any_arg_tainted(self, node):
+        return any(self._expr_tainted(a) for a in node.args) or \
+            any(self._expr_tainted(kw.value) for kw in node.keywords)
+
+    def _check_call(self, node):
+        d = dotted_name(node.func)
+        mod = self.module
+
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_SYNC_METHODS:
+            if self._expr_tainted(node.func.value):
+                self.an.emit(mod, node.lineno, "host-sync", self.qual,
+                             node.func.attr,
+                             ".%s() on a traced value inside jitted code "
+                             "forces a host sync" % node.func.attr)
+            return
+
+        if d is None:
+            return
+
+        if d in _HOST_CAST_BUILTINS and self._any_arg_tainted(node):
+            self.an.emit(mod, node.lineno, "host-sync", self.qual, d,
+                         "%s() on a traced value inside jitted code "
+                         "forces a host sync (use jnp casts instead)" % d)
+            return
+        if d == "print":
+            self.an.emit(mod, node.lineno, "impure-print", self.qual,
+                         "print",
+                         "print() inside jitted code runs once at trace "
+                         "time only (use jax.debug.print)")
+            return
+
+        if "." in d:
+            base = _base_module(mod, d)
+            attr = d.split(".")[-1]
+            if base == "numpy" and attr in _NUMPY_HOST_FUNCS and \
+                    self._any_arg_tainted(node):
+                self.an.emit(mod, node.lineno, "host-sync", self.qual, d,
+                             "np.%s() on a traced value materializes it "
+                             "on host inside jitted code (use jnp.%s)"
+                             % (attr, attr))
+                return
+            if base == "jax" and attr == "device_get":
+                self.an.emit(mod, node.lineno, "host-sync", self.qual, d,
+                             "jax.device_get inside jitted code forces a "
+                             "host transfer")
+                return
+            if base in _TIME_MODULES:
+                self.an.emit(mod, node.lineno, "impure-time", self.qual, d,
+                             "%s() inside jitted code reads the clock at "
+                             "trace time only — the compiled program "
+                             "bakes in a constant" % d)
+                return
+            if base in _RANDOM_MODULES:
+                self.an.emit(mod, node.lineno, "impure-random", self.qual,
+                             d,
+                             "%s() inside jitted code draws at trace "
+                             "time only — every step replays the same "
+                             "value (thread a jax PRNG key instead)" % d)
+                return
+
+        # follow resolvable callees with positional taint binding
+        self.an.follow_call(self, node, d)
+
+
+class JitPurity(object):
+    def __init__(self, repo):
+        self.repo = repo
+        self.findings = []
+        self._visited = set()
+
+    def emit(self, module, lineno, rule, symbol, detail, message):
+        self.findings.append(Finding(PASS_ID, rule, module.relpath, lineno,
+                                     symbol, detail, message))
+
+    # -------------------------------------------------------- traversal
+    def walk_traced(self, module, fn, qual, tainted, depth):
+        key = (id(fn), frozenset(tainted))
+        if key in self._visited or depth > _MAX_DEPTH:
+            return
+        self._visited.add(key)
+        w = _TracedWalker(self, module, fn, qual, tainted, depth)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            w.visit(stmt)
+
+    def follow_call(self, walker, node, d):
+        module, fn, scope = walker.module, None, None
+        scopes = self._scopes_cache(module)
+        # local closure first: resolve through the lexical scope chain
+        if "." not in d:
+            sc = scopes.get(walker.fn)
+            if sc is not None:
+                fn = sc[0].lookup(d)
+        if fn is None:
+            resolved = self.repo.resolve_function(module, d)
+            if resolved is None:
+                return
+            module, fn = resolved
+            scopes = self._scopes_cache(module)
+        params = _param_names(fn)
+        tainted = set()
+        for i, a in enumerate(node.args):
+            if walker._expr_tainted(a) and i < len(params):
+                tainted.add(params[i])
+        for kw in node.keywords:
+            if kw.arg and kw.arg in params and \
+                    walker._expr_tainted(kw.value):
+                tainted.add(kw.arg)
+        if not tainted:
+            return
+        entry = scopes.get(fn)
+        qual = entry[1] if entry else d
+        self.walk_traced(module, fn, qual, tainted, walker.depth + 1)
+
+    def _scopes_cache(self, module):
+        if not hasattr(module, "_mxa_scopes"):
+            module._mxa_scopes = _collect_scopes(module.tree)
+        return module._mxa_scopes
+
+    # ---------------------------------------------------------- entries
+    def _entry_taint(self, fn, jit_call):
+        params = _param_names(fn)
+        if jit_call is None:
+            return set(params)
+        nums, names = _static_params(jit_call)
+        return {p for i, p in enumerate(params)
+                if i not in nums and p not in names}
+
+    def _handle_entry(self, module, fn, qual, jit_call):
+        tainted = self._entry_taint(fn, jit_call)
+        self.walk_traced(module, fn, qual, tainted, 0)
+
+    def _check_donated_reuse(self, module, scopes, enclosing, jit_call):
+        """fn = jax.jit(f, donate_argnums=...); fn(a, b); <use of a>."""
+        donated = _donated_positions(jit_call)
+        if not donated or enclosing is None:
+            return
+        # which local name holds the jitted program?
+        parents = self._parents(module)
+        holder = None
+        p = parents.get(jit_call)
+        if isinstance(p, ast.Assign) and len(p.targets) == 1 and \
+                isinstance(p.targets[0], ast.Name):
+            holder = p.targets[0].id
+        if holder is None:
+            return
+        body = enclosing.body if isinstance(enclosing.body, list) else []
+        qual = scopes[enclosing][1] if enclosing in scopes else ""
+        for call in [n for n in ast.walk(enclosing)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)
+                     and n.func.id == holder]:
+            # dispatch inside a loop re-binds buffers per iteration;
+            # statement order is meaningless there — skip
+            anc, in_loop = parents.get(call), False
+            while anc is not None and anc is not enclosing:
+                if isinstance(anc, (ast.For, ast.While)):
+                    in_loop = True
+                    break
+                anc = parents.get(anc)
+            if in_loop:
+                continue
+            donated_vars = {a.id for i, a in enumerate(call.args)
+                            if i in donated and isinstance(a, ast.Name)}
+            if not donated_vars:
+                continue
+            for node in ast.walk(enclosing):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in donated_vars and \
+                        node.lineno > call.lineno:
+                    self.emit(module, node.lineno, "donated-reuse", qual,
+                              node.id,
+                              "buffer %r was donated to the dispatch on "
+                              "line %d — its device memory may already "
+                              "be aliased-over" % (node.id, call.lineno))
+
+    def _parents(self, module):
+        if not hasattr(module, "_mxa_parents"):
+            parents = {}
+            for node in ast.walk(module.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            module._mxa_parents = parents
+        return module._mxa_parents
+
+    def run(self):
+        for module in self.repo.modules:
+            # cheap prefilter: a module with no "jit" token has no entry
+            # points (cross-module helpers are still walked lazily when
+            # a traced body reaches them)
+            if "jit" not in module.text:
+                continue
+            entries = [n for n in ast.walk(module.tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.Call))]
+            if not any(isinstance(n, ast.Call) and
+                       _is_jit_callee(module, n.func) for n in entries) \
+                    and not any(
+                        isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                        and n.decorator_list for n in entries):
+                continue
+            scopes = self._scopes_cache(module)
+            parents = self._parents(module)
+            # decorator entries: @jax.jit / @partial(jax.jit, ...)
+            for node in entries:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        call = None
+                        target = dec
+                        if isinstance(dec, ast.Call):
+                            d = dotted_name(dec.func)
+                            if d and d.split(".")[-1] == "partial" and \
+                                    dec.args and \
+                                    _is_jit_callee(module, dec.args[0]):
+                                call, target = dec, dec.args[0]
+                            else:
+                                call, target = dec, dec.func
+                        if _is_jit_callee(module, target):
+                            qual = scopes[node][1] if node in scopes \
+                                else node.name
+                            self._handle_entry(module, node, qual, call)
+                            break
+            # call-site entries: jax.jit(fn | lambda, ...)
+            for node in entries:
+                if not (isinstance(node, ast.Call) and
+                        _is_jit_callee(module, node.func) and node.args):
+                    continue
+                arg = node.args[0]
+                fn = None
+                if isinstance(arg, ast.Lambda):
+                    fn = arg
+                elif isinstance(arg, ast.Name):
+                    # resolve through the lexical scope of the jit call
+                    anc = parents.get(node)
+                    while anc is not None and anc not in scopes:
+                        anc = parents.get(anc)
+                    sc = scopes.get(anc, scopes[module.tree])[0]
+                    fn = sc.lookup(arg.id) if sc else None
+                    if fn is None:
+                        fn = module.top_funcs.get(arg.id)
+                if fn is None:
+                    continue
+                q = scopes[fn][1] if fn in scopes else \
+                    getattr(fn, "name", "<lambda>")
+                self._handle_entry(module, fn, q, node)
+                # donated-buffer reuse in the dispatching scope
+                anc = parents.get(node)
+                while anc is not None and not isinstance(
+                        anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    anc = parents.get(anc)
+                self._check_donated_reuse(module, scopes, anc, node)
+        return self.findings
+
+
+def run(repo):
+    return JitPurity(repo).run()

@@ -1,0 +1,369 @@
+"""Lock-discipline race detection (pass id: ``locks``).
+
+Two complementary modes:
+
+**Annotation-driven.**  ``self._attr = ...  # guarded-by: _lock`` (or a
+module-global ``_SINK = None  # guarded-by: _SINK_LOCK``) declares the
+lock that must be held around every access; ``guarded-by[writes]``
+restricts the obligation to writes, documenting that lock-free reads
+are an accepted benign race (the hot-path pattern tracing.py/telemetry.py
+use).  ``# mxlint: holds(_lock)`` on a ``def`` marks a function whose
+callers always hold the lock (the assertHeld analog), e.g.
+``Server._take_fitting`` which only runs under ``_cond``.
+
+**Inference.**  For every class that starts a thread
+(``threading.Thread(target=self._loop)``, possibly wrapped in
+``tracing.wrap_context(...)``, or a worker ``def`` local to the starting
+method), the pass computes the methods reachable from the thread entry
+(following ``self.method()`` calls), collects the ``self._x`` attributes
+*written* there, and intersects with attributes accessed from foreground
+methods.  Any access to such a cross-thread attribute outside a
+``with self.<lock>`` scope is flagged — even when the attribute carries
+no annotation yet.  ``__init__`` is exempt (it runs before the thread
+exists).
+
+Constructor-time writes aside, the lexical ``with`` scope is the unit of
+"holding": a nested ``def`` does not inherit its enclosing ``with``
+(it may run later on another thread), which is also why worker closures
+get analyzed as thread entries of their own.
+"""
+from __future__ import annotations
+
+import ast
+
+from .walker import Finding, dotted_name
+
+PASS_ID = "locks"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(module, value):
+    if not isinstance(value, ast.Call):
+        return False
+    d = dotted_name(value.func)
+    if not d:
+        return False
+    leaf = d.split(".")[-1]
+    if leaf not in _LOCK_FACTORIES:
+        return False
+    if "." in d:
+        root = module.resolve_alias(d.split(".")[0]) or d.split(".")[0]
+        return root == "threading"
+    src = module.from_imports.get(leaf)
+    return bool(src and src[0] == "threading")
+
+
+def _self_attr(node):
+    """'attr' if node is ``self.attr``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _AccessCollector(ast.NodeVisitor):
+    """Collects (attr, lineno, is_write, held_locks) accesses of
+    ``self.*`` (or module globals) within one function, tracking the
+    lexically-held lock set through ``with`` statements."""
+
+    def __init__(self, module, fn, attr_mode=True, names=None):
+        self.module = module
+        self.attr_mode = attr_mode      # False: module-global Name mode
+        self.names = names              # globals of interest (Name mode)
+        self.accesses = []              # (name, lineno, is_write, held)
+        self.nested_entries = []        # nested defs (analyzed separately)
+        held = set()
+        lock = module.holds_decl(fn)
+        if lock:
+            held.add(lock)
+        self._held = held
+        for stmt in fn.body if isinstance(fn.body, list) else [fn.body]:
+            self.visit(stmt)
+
+    def _locks_in_withitem(self, item):
+        expr = item.context_expr
+        attr = _self_attr(expr)
+        if attr is not None:
+            return {attr}
+        if isinstance(expr, ast.Name):
+            return {expr.id}
+        # ``with self._lock, self._cond:`` handled per-item by caller;
+        # ``with foo.lock():`` — opaque, hold nothing
+        return set()
+
+    def visit_With(self, node):
+        added = set()
+        for item in node.items:
+            added |= self._locks_in_withitem(item)
+        self._held |= added
+        for stmt in node.body:
+            self.visit(stmt)
+        self._held -= added
+
+    def visit_FunctionDef(self, node):
+        # a nested def may run later / on another thread: it does NOT
+        # inherit the enclosing with-scope
+        self.nested_entries.append(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _record(self, name, node, is_write):
+        self.accesses.append((name, node.lineno, is_write,
+                              frozenset(self._held)))
+
+    def visit_Attribute(self, node):
+        if self.attr_mode:
+            attr = _self_attr(node)
+            if attr is not None:
+                self._record(attr, node, isinstance(
+                    node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if not self.attr_mode and node.id in self.names:
+            self._record(node.id, node,
+                         isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # ``X[0] = v`` writes through the container: count it as a write
+        # of the container slot for single-element global slots
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            if self.attr_mode:
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    self._record(attr, node, True)
+                    self.generic_visit(node.slice)
+                    return
+            elif isinstance(node.value, ast.Name) and \
+                    node.value.id in self.names:
+                self._record(node.value.id, node, True)
+                self.generic_visit(node.slice)
+                return
+        self.generic_visit(node)
+
+
+class LockDiscipline(object):
+    def __init__(self, repo):
+        self.repo = repo
+        self.findings = []
+
+    def emit(self, module, lineno, rule, symbol, detail, message):
+        self.findings.append(Finding(PASS_ID, rule, module.relpath, lineno,
+                                     symbol, detail, message))
+
+    # -------------------------------------------------- module globals
+    def _check_globals(self, module):
+        guards = {}                     # global name -> (lock, mode)
+        for node in module.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            decl = module.guard_decl(node.lineno)
+            if not decl:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    guards[t.id] = decl
+        if not guards:
+            return
+        names = set(guards)
+        for fn, qual in _iter_functions(module.tree):
+            coll = _AccessCollector(module, fn, attr_mode=False,
+                                    names=names)
+            stack = list(coll.nested_entries)
+            colls = [(coll, qual)]
+            while stack:
+                nested = stack.pop()
+                c = _AccessCollector(module, nested, attr_mode=False,
+                                     names=names)
+                colls.append((c, qual + "." + nested.name))
+                stack.extend(c.nested_entries)
+            for c, q in colls:
+                for name, lineno, is_write, held in c.accesses:
+                    lock, mode = guards[name]
+                    if mode == "writes" and not is_write:
+                        continue
+                    if lock in held:
+                        continue
+                    kind = "write" if is_write else "read"
+                    self.emit(module, lineno, "unguarded-" + kind, q,
+                              name,
+                              "%s of %s outside 'with %s' (declared "
+                              "guarded-by%s)" % (
+                                  kind, name, lock,
+                                  "[writes]" if mode == "writes" else ""))
+
+    # --------------------------------------------------------- classes
+    def _thread_entries(self, module, cls):
+        """Method names / local defs used as thread targets, plus the
+        methods that start threads (for locating worker closures)."""
+        entry_methods = set()
+        entry_local_defs = []           # (method, def node)
+        for method in [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]:
+            local_defs = {n.name: n for n in ast.walk(method)
+                          if isinstance(n, ast.FunctionDef)
+                          and n is not method}
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if not d or d.split(".")[-1] != "Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    # unwrap tracing.wrap_context(...) and friends: any
+                    # self.method / local def referenced by the target
+                    # expression runs on the new thread
+                    for sub in ast.walk(kw.value):
+                        attr = _self_attr(sub)
+                        if attr is not None:
+                            entry_methods.add(attr)
+                        elif isinstance(sub, ast.Name) and \
+                                sub.id in local_defs:
+                            entry_local_defs.append(
+                                (method, local_defs[sub.id]))
+        return entry_methods, entry_local_defs
+
+    def _reachable_background(self, cls, entry_methods):
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        seen = set()
+        work = [m for m in entry_methods if m in methods]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call):
+                    attr = _self_attr(node.func)
+                    if attr and attr in methods and attr not in seen:
+                        work.append(attr)
+        return {methods[n] for n in seen}, methods
+
+    def _check_class(self, module, cls):
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+        if not methods:
+            return
+        lock_attrs, attr_guards = set(), {}
+        for m in methods:
+            for node in ast.walk(m):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                if not targets:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if isinstance(node, ast.Assign) and \
+                            _is_lock_ctor(module, node.value):
+                        lock_attrs.add(attr)
+                    decl = module.guard_decl(node.lineno)
+                    if decl:
+                        attr_guards.setdefault(attr, decl)
+        for attr, (lock, _m) in attr_guards.items():
+            lock_attrs.add(lock)
+
+        entry_methods, entry_local_defs = self._thread_entries(module, cls)
+        bg_nodes, method_map = self._reachable_background(
+            cls, entry_methods)
+        bg_entry_defs = [d for _m, d in entry_local_defs]
+
+        # collect accesses per method, background defs included
+        per_fn = []                     # (fn, qual, is_bg, collector)
+        for m in methods:
+            qual = cls.name + "." + m.name
+            coll = _AccessCollector(module, m)
+            is_bg = m in bg_nodes
+            per_fn.append((m, qual, is_bg, coll))
+            stack = [(n, is_bg or n in bg_entry_defs)
+                     for n in coll.nested_entries]
+            while stack:
+                nested, nested_bg = stack.pop()
+                nested_bg = nested_bg or nested in bg_entry_defs
+                c = _AccessCollector(module, nested)
+                per_fn.append((nested, qual + "." + nested.name,
+                               nested_bg, c))
+                stack.extend((n, nested_bg) for n in c.nested_entries)
+
+        # inference: attrs written on the background side, accessed on
+        # the foreground side (constructor exempt on both)
+        bg_writes, fg_accessed = set(), set()
+        for fn, qual, is_bg, coll in per_fn:
+            if fn.name == "__init__":
+                continue
+            for name, _l, is_write, _h in coll.accesses:
+                if is_bg and is_write:
+                    bg_writes.add(name)
+                if not is_bg:
+                    fg_accessed.add(name)
+        inferred = (bg_writes & fg_accessed) - lock_attrs
+        inferred -= set(attr_guards)    # annotated attrs checked directly
+
+        if not attr_guards and not inferred:
+            return
+
+        for fn, qual, is_bg, coll in per_fn:
+            if fn.name == "__init__":
+                continue
+            for name, lineno, is_write, held in coll.accesses:
+                kind = "write" if is_write else "read"
+                if name in attr_guards:
+                    lock, mode = attr_guards[name]
+                    if mode == "writes" and not is_write:
+                        continue
+                    if lock in held:
+                        continue
+                    self.emit(module, lineno, "unguarded-" + kind, qual,
+                              name,
+                              "%s of self.%s outside 'with self.%s' "
+                              "(declared guarded-by%s)" % (
+                                  kind, name, lock,
+                                  "[writes]" if mode == "writes" else ""))
+                elif name in inferred:
+                    if held & lock_attrs:
+                        continue
+                    self.emit(module, lineno, "unguarded-" + kind, qual,
+                              name,
+                              "%s of self.%s without a lock: it is "
+                              "written on a background-thread path and "
+                              "accessed from other threads — guard it "
+                              "or annotate '# guarded-by: <lock>'"
+                              % (kind, name))
+
+    def run(self):
+        for module in self.repo.modules:
+            self._check_globals(module)
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(module, node)
+        return self.findings
+
+
+def _iter_functions(tree):
+    """Top-level and class-level functions with qualnames (nested defs
+    are pulled in by the collectors themselves)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield sub, node.name + "." + sub.name
+
+
+def run(repo):
+    return LockDiscipline(repo).run()

@@ -518,6 +518,10 @@ class PrefetchingIter(DataIter):
             from . import config as _config
             depth = _config.get("io.prefetch_depth")
         self.iters = iters
+        # Concurrency discipline (lock-checked by tools/mxlint.py): the
+        # worker closes over snapshots of _stop/_queue, never reads them
+        # through self, so the consumer thread may rebind them in reset()
+        # without a lock — cross-thread handoff is the Queue itself.
         self._queue = queue.Queue(maxsize=max(1, int(depth)))
         self._stop = threading.Event()
         self._thread = None
@@ -630,6 +634,11 @@ class DevicePrefetcher(DataIter):
         self.iters = iters
         self._placement = placement
         self._buckets = _bucket_sizes(buckets, self.batch_size)
+        # Concurrency discipline (lock-checked by tools/mxlint.py): the
+        # worker closes over snapshots of _stop/_queue/put; _seen_shapes
+        # and the padding state are touched only from the worker thread
+        # (reset() joins it before rebinding anything), so the class
+        # needs no lock — cross-thread handoff is the Queue itself.
         self._seen_shapes = set()
         self._queue = queue.Queue(maxsize=max(1, int(depth)))
         self._stop = threading.Event()

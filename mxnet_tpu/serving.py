@@ -199,12 +199,15 @@ class _Breaker:
         self.model = model
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
-        self.state = "closed"
-        self.failures = 0
-        self.opened_at = 0.0
+        # Reads on the submit fast path are deliberately lock-free (a
+        # stale read only delays a fast-fail by one batch), so only the
+        # writes are lock-checked.
+        self.state = "closed"    # guarded-by[writes]: _lock
+        self.failures = 0        # guarded-by[writes]: _lock
+        self.opened_at = 0.0     # guarded-by[writes]: _lock
         self._lock = threading.Lock()
 
-    def _set_state(self, state):
+    def _set_state(self, state):  # mxlint: holds(_lock)
         self.state = state
         _telemetry.gauge("serving.breaker_state.%s" % self.model).set(
             _BREAKER_STATE_VALUE[state])
@@ -397,15 +400,19 @@ class Server:
         self.default_deadline_ms = float(default_deadline_ms)
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_ms = float(breaker_cooldown_ms)
-        self._models = OrderedDict()     # name -> _ModelEntry (LRU order)
-        self._pending = deque()
+        # Cross-thread state below is lock-checked by tools/mxlint.py
+        # (docs/ANALYSIS.md): every access must hold _cond unless the
+        # annotation says writes-only.
+        self._models = OrderedDict()     # guarded-by: _cond — _ModelEntry, LRU order
+        self._pending = deque()          # guarded-by: _cond
         self._cond = threading.Condition()
+        # guarded-by[writes]: _cond — stop() joins outside the lock
         self._thread = None
         self._leaked_thread = None       # batcher that missed stop()'s join
-        self._batcher_dead = None        # causal exc once restarts exhaust
-        self._started = False
-        self._stopping = False
-        self._last_dispatch_done = _time.perf_counter()
+        self._batcher_dead = None        # guarded-by: _cond — exc once restarts exhaust
+        self._started = False            # guarded-by: _cond
+        self._stopping = False           # guarded-by: _cond
+        self._last_dispatch_done = _time.perf_counter()  # guarded-by: _cond
         self._probe_name = "serving-%x" % id(self)
 
     # ------------------------------------------------------------ models
@@ -457,11 +464,12 @@ class Server:
             while len(self._models) > self.max_models:
                 victim, _ = self._models.popitem(last=False)
                 evicted.append(victim)
+            started = self._started
         for victim in evicted:
             _telemetry.counter("serving.models_evicted").inc()
             _LOG.info("serving: evicted LRU model %r (max_models=%d)",
                       victim, self.max_models)
-        if self._started:
+        if started:
             self._compile_entry(entry)
         return entry
 
@@ -519,8 +527,9 @@ class Server:
         is STILL running, in which case this raises instead of racing two
         batchers on one queue (the ``PrefetchingIter.reset`` contract)."""
         from . import tracing as _tracing
-        if self._started:
-            return self
+        with self._cond:
+            if self._started:
+                return self
         if self._leaked_thread is not None:
             if self._leaked_thread.is_alive():
                 raise ServingError(
@@ -534,15 +543,19 @@ class Server:
             entries = list(self._models.values())
         for entry in entries:
             self._compile_entry(entry)
-        self._stopping = False
-        self._batcher_dead = None
-        self._last_dispatch_done = _time.perf_counter()
-        self._started = True
-        # wrap_context: dispatch spans keep the starter's trace parentage
-        # across the thread hop (the io.prefetch pattern)
-        self._thread = threading.Thread(
-            target=_tracing.wrap_context(self._supervise), daemon=True,
-            name="mx-serving-batcher")
+        # lifecycle flags flip under _cond: _enqueue and the batcher read
+        # them under the same lock, so a submit racing start() sees either
+        # the fully-started server or the stopped one — never a torn state
+        with self._cond:
+            self._stopping = False
+            self._batcher_dead = None
+            self._last_dispatch_done = _time.perf_counter()
+            self._started = True
+            # wrap_context: dispatch spans keep the starter's trace
+            # parentage across the thread hop (the io.prefetch pattern)
+            self._thread = threading.Thread(
+                target=_tracing.wrap_context(self._supervise), daemon=True,
+                name="mx-serving-batcher")
         self._thread.start()
         _tracing.register_stall_probe(self._probe_name, self._stall_probe)
         return self
@@ -581,8 +594,9 @@ class Server:
                     timeout_s)
         from . import tracing as _tracing
         _tracing.unregister_stall_probe(self._probe_name)
-        self._started = False
-        self._thread = None
+        with self._cond:
+            self._started = False
+            self._thread = None
 
     def __enter__(self):
         return self.start()
@@ -772,7 +786,7 @@ class Server:
                 entry.deadline_exceeded += 1
 
     # ----------------------------------------------------------- batcher
-    def _take_fitting(self, model, budget):
+    def _take_fitting(self, model, budget):  # mxlint: holds(_cond)
         """Pop the first queued request for ``model`` with rows <=
         ``budget`` (caller holds the condition lock).  Queued requests
         whose deadline has expired are harvested as a second return value —
@@ -939,7 +953,8 @@ class Server:
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)
-            self._last_dispatch_done = _time.perf_counter()
+            with self._cond:
+                self._last_dispatch_done = _time.perf_counter()
             return
         try:
             if _resilience.faults_active("serving_slow") \
@@ -970,7 +985,8 @@ class Server:
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)
-            self._last_dispatch_done = _time.perf_counter()
+            with self._cond:
+                self._last_dispatch_done = _time.perf_counter()
             return
         if breaker is not None:
             breaker.record_success()
@@ -987,7 +1003,8 @@ class Server:
             _telemetry.counter("serving.quantized_dispatches").inc()
         _telemetry.timer("serving.batch_fill").observe(rows / bucket)
         _telemetry.timer("serving.dispatch_ms").observe((t1 - t0) * 1e3)
-        self._last_dispatch_done = t1
+        with self._cond:
+            self._last_dispatch_done = t1
         # one JSONL record per dispatch (no-op when the sink is off);
         # tools/telemetry_report.py folds these into the serving table,
         # the queue-delay anomaly and the overload-shedding anomaly

@@ -49,11 +49,14 @@ __all__ = ["Counter", "Gauge", "Timer", "counter", "gauge", "timer",
            "device_memory_bytes", "validate_step_record", "STEP_SOURCES"]
 
 # one structure lock guards the name->instrument maps; each instrument then
-# carries its own lock so hot-path observations never contend on the registry
+# carries its own lock so hot-path observations never contend on the
+# registry.  _get_or_create reads the maps lock-free (double-checked
+# locking: dict lookup is atomic, inserts happen under the lock), so only
+# the writes are lock-checked.
 _REGISTRY_LOCK = threading.Lock()
-_COUNTERS = {}
-_GAUGES = {}
-_TIMERS = {}
+_COUNTERS = {}  # guarded-by[writes]: _REGISTRY_LOCK
+_GAUGES = {}    # guarded-by[writes]: _REGISTRY_LOCK
+_TIMERS = {}    # guarded-by[writes]: _REGISTRY_LOCK
 
 STEP_SOURCES = ("module", "spmd", "gluon")
 
@@ -78,7 +81,7 @@ class Counter:
 
     def __init__(self, name):
         self.name = name
-        self._value = 0
+        self._value = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, delta=1):
@@ -103,7 +106,7 @@ class Gauge:
 
     def __init__(self, name):
         self.name = name
-        self._value = 0
+        self._value = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value):
@@ -131,11 +134,11 @@ class Timer:
 
     def __init__(self, name):
         self.name = name
-        self.count = 0
-        self.total = 0.0
-        self.min = None
-        self.max = None
-        self._samples = deque(maxlen=self.MAX_SAMPLES)
+        self.count = 0      # guarded-by: _lock
+        self.total = 0.0    # guarded-by: _lock
+        self.min = None     # guarded-by: _lock
+        self.max = None     # guarded-by: _lock
+        self._samples = deque(maxlen=self.MAX_SAMPLES)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, seconds):
@@ -256,9 +259,13 @@ def reset():
 
 
 # --------------------------------------------------------------- step log
+# Rebound only under _SINK_LOCK; the `_SINK is None` fast checks on the
+# log_event/enabled paths read lock-free on purpose (a stale None just
+# drops one record during reconfigure), hence [writes] mode.
 _SINK_LOCK = threading.Lock()
-_SINK = None        # open line-buffered file, or None when off
-_SINK_PATH = None
+# guarded-by[writes]: _SINK_LOCK — open line-buffered file, None when off
+_SINK = None
+_SINK_PATH = None   # guarded-by[writes]: _SINK_LOCK
 
 
 def configure_sink(spec):

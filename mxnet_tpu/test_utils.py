@@ -50,6 +50,38 @@ def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
                names[1], b[idx] if b.shape else b))
 
 
+def with_seed(seed=None):
+    """Decorator seeding numpy's global RNG per test call, mirroring the
+    reference ``common.with_seed``: an explicit ``seed`` wins, else the
+    ``test.seed`` knob (``MXNET_TEST_SEED``) when set to >= 0, else a
+    fresh draw — which is logged on failure so the run can be replayed
+    with ``MXNET_TEST_SEED=<n>``."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from . import config as _config
+            use = seed
+            if use is None:
+                knob = _config.get("test.seed")
+                use = knob if knob is not None and knob >= 0 else None
+            if use is None:
+                use = int(_np.random.randint(0, 2 ** 31))
+            _np.random.seed(use)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).error(
+                    "%s failed with seed %d; rerun with MXNET_TEST_SEED=%d",
+                    getattr(fn, "__name__", "test"), use, use)
+                raise
+        return wrapper
+
+    return deco
+
+
 def rand_shape_nd(ndim, dim=10):
     return tuple(_np.random.randint(1, dim + 1, size=ndim).tolist())
 

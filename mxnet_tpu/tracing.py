@@ -85,13 +85,17 @@ def _unix_from_perf(t_perf):
 # thread stuck" report.  Guarded by its own lock; entries exist only while
 # tracing is active, so the hot path pays nothing when off.
 _OPEN_LOCK = threading.Lock()
-_OPEN = {}
+_OPEN = {}  # guarded-by: _OPEN_LOCK
 
 # ------------------------------------------------------------ chrome sink
+# Sink state is rebound only under _SINK_LOCK; the `_SINK is None` fast
+# checks on the emit path read lock-free on purpose (a stale None just
+# drops one event during reconfigure), hence [writes] mode.
 _SINK_LOCK = threading.Lock()
-_SINK = None
-_SINK_PATH = None
-_SINK_THREADS = None  # idents that already emitted a thread_name metadata
+_SINK = None          # guarded-by[writes]: _SINK_LOCK
+_SINK_PATH = None     # guarded-by[writes]: _SINK_LOCK
+# guarded-by[writes]: _SINK_LOCK — idents that already emitted thread_name
+_SINK_THREADS = None
 
 
 def configure_sink(spec):
@@ -175,7 +179,7 @@ def flush():
 
 # --------------------------------------------------------- flight recorder
 _RING_LOCK = threading.Lock()
-_RING = deque(maxlen=256)
+_RING = deque(maxlen=256)  # guarded-by: _RING_LOCK
 
 
 def configure_ring(size):
@@ -334,11 +338,14 @@ def wrap_context(fn):
 
 
 # -------------------------------------------------------------- watchdog
+# Watchdog state is (re)armed only under _WD_LOCK; the hot-path
+# `_WD_DEADLINE is not None` checks and the report writer read lock-free
+# (worst case: one poll against a stale deadline), hence [writes] mode.
 _WD_LOCK = threading.Lock()
-_WD_DEADLINE = None     # seconds, None when off
-_WD_THREAD = None
-_WD_STOP = None
-_WD_REPORT_DIR = ""
+_WD_DEADLINE = None     # guarded-by[writes]: _WD_LOCK — seconds, None=off
+_WD_THREAD = None       # guarded-by[writes]: _WD_LOCK
+_WD_STOP = None         # guarded-by[writes]: _WD_LOCK
+_WD_REPORT_DIR = ""     # guarded-by[writes]: _WD_LOCK
 # perf_counter of the last completed train step (any source); the watchdog
 # measures hang age against this
 _LAST_PROGRESS = [time.perf_counter()]
@@ -350,7 +357,7 @@ _LAST_PROGRESS = [time.perf_counter()]
 # returns.  Probes must be fast, thread-safe, and never raise (exceptions
 # are swallowed — the watchdog must not die).
 _PROBE_LOCK = threading.Lock()
-_STALL_PROBES = {}
+_STALL_PROBES = {}  # guarded-by: _PROBE_LOCK
 
 
 def register_stall_probe(name, fn):

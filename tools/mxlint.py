@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""mxlint — the mx.analysis static-analysis CLI (docs/ANALYSIS.md).
+
+Runs the jit-purity, lock-discipline and registry-drift passes over the
+framework tree and exits non-zero on any active finding:
+
+    python tools/mxlint.py                 # lint, human output
+    python tools/mxlint.py --json          # machine output
+    python tools/mxlint.py --passes drift  # one pass family
+    python tools/mxlint.py --fix-docs      # regenerate ENV_VARS.md +
+                                           # the OBSERVABILITY metric
+                                           # index, then re-lint
+
+Findings are suppressed either inline (``# mxlint: disable=pass.rule``)
+or through tools/mxlint_baseline.json, where every entry carries a
+one-line justification; baseline entries that no longer match anything
+are reported as expired and fail the lint, so the ledger cannot rot.
+
+The pass package lives at mxnet_tpu/analysis/ but is loaded here
+*without* importing ``mxnet_tpu`` itself (which would pull in jax): a
+full-tree lint stays fast enough for the bench preflight and CI smoke
+(tools/check_analysis.py).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "mxlint_baseline.json")
+
+_SHIM_NAME = "_mx_analysis_standalone"
+
+
+def load_analysis(root=ROOT):
+    """Import mxnet_tpu/analysis as a standalone package.
+
+    ``import mxnet_tpu.analysis`` would execute mxnet_tpu/__init__.py
+    (jax, the full framework) just to lint source text; instead the
+    package is loaded under a private name with its own search path so
+    its relative imports resolve without touching the parent package.
+    """
+    if _SHIM_NAME in sys.modules:
+        return sys.modules[_SHIM_NAME]
+    pkg_dir = os.path.join(root, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        _SHIM_NAME, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_SHIM_NAME] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        del sys.modules[_SHIM_NAME]
+        raise
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=ROOT,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (jit,locks,drift); "
+                         "default all")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: "
+                         "tools/mxlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--fix-docs", action="store_true",
+                    help="regenerate docs/ENV_VARS.md and the "
+                         "docs/OBSERVABILITY.md metric index, then lint")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis(args.root if os.path.isdir(
+        os.path.join(args.root, "mxnet_tpu", "analysis")) else ROOT)
+
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = [p for p in passes if p not in analysis.PASSES]
+        if unknown:
+            ap.error("unknown pass id(s): %s (have: %s)"
+                     % (", ".join(unknown),
+                        ", ".join(analysis.PASSES)))
+
+    fixed = []
+    if args.fix_docs:
+        repo = analysis.Repo(args.root)
+        fixed = analysis.drift.fix_docs(repo)
+
+    baseline = None if args.no_baseline else args.baseline
+    report = analysis.run(args.root, passes=passes, baseline=baseline)
+
+    if args.as_json:
+        out = report.to_dict()
+        out["fixed_docs"] = fixed
+        print(json.dumps(out, sort_keys=True))
+        return 0 if report.ok else 1
+
+    for rel in fixed:
+        print("mxlint: rewrote %s" % rel)
+    for rel, err in report.repo.parse_errors:
+        print("%s:0: [parse-error] %s" % (rel, err))
+    for f in report.active:
+        print(f.format())
+    n_active = len(report.active) + len(report.repo.parse_errors)
+    n_sup = len(report.suppressed)
+    if n_active:
+        print("mxlint: %d finding(s)%s" % (
+            n_active,
+            " (%d suppressed)" % n_sup if n_sup else ""))
+        return 1
+    print("mxlint: clean%s" % (
+        " (%d suppressed by baseline/inline)" % n_sup if n_sup else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
