@@ -320,6 +320,11 @@ def run_bench(runs_out):
         runs_out.append({"mode": "input_pipeline",
                          "error": "%s: %s" % (type(e).__name__, e)})
     try:
+        dlrm_embedding_config(runs_out, 24 if on_tpu else 8)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "dlrm_embedding",
+                         "error": "%s: %s" % (type(e).__name__, e)})
+    try:
         infer_config(128 if on_tpu else 16, "bfloat16",
                      100 if on_tpu else 3)
     except Exception as e:  # noqa: BLE001
@@ -552,6 +557,72 @@ def input_pipeline_config(runs_out, steps):
                      "device_over_host": round(sps_dev / sps_host, 3)})
 
 
+def dlrm_embedding_config(runs_out, steps):
+    """Secondary headline: recommendation-style embedding training — the
+    deduplicated row-sparse path vs the dense-gradient baseline.
+
+    The same seeded model (a >=100k-row ``Embedding(sparse_grad=True)``
+    feeding a small MLP) trains on the same Zipf-distributed id batches
+    two ways: ``embedding.sharded`` ON routes the table through
+    mx.parallel.embedding (dedup + ``step_rows``, O(rows-touched) per
+    step), OFF takes the dense path (full-table cotangent + full-table
+    optimizer step).  samples/s for both land under runs[] with mode
+    "dlrm_embedding" and surface as the dlrm_embedding_throughput
+    secondary; target is >=3x sparse-over-dense on tables >=100k rows
+    (docs/PERF_NOTES.md sharded-embedding section)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _cfg
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    VOCAB, DIM, BATCH, SLOTS = 1_000_000, 32, 256, 8
+    rng = np.random.RandomState(5)
+    # Zipf traffic: heavy head, long tail — the dedup-friendly real shape
+    batches = [np.minimum(rng.zipf(1.5, (BATCH, SLOTS)), VOCAB)
+                 .astype(np.int32) - 1 for _ in range(8)]
+    labels = [rng.randn(BATCH, 1).astype(np.float32) for _ in range(8)]
+    unique_ratio = float(np.mean(
+        [np.unique(b).size / b.size for b in batches]))
+
+    def run(sparse):
+        _cfg.set("embedding.sharded", sparse)
+        mx.random.seed(9)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Embedding(VOCAB, DIM, sparse_grad=True))
+            net.add(nn.Flatten())
+            net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(1))
+        net.initialize(mx.init.Xavier())
+        tr = SPMDTrainer(net, gloss.L2Loss(), "sgd",
+                         {"learning_rate": 0.05})
+        loss = tr.step(batches[0], labels[0])     # compile
+        np.asarray(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = tr.step(batches[i % len(batches)],
+                           labels[i % len(batches)])
+        np.asarray(loss)                 # forced sync terminates timing
+        return BATCH * steps / (time.perf_counter() - t0)
+
+    try:
+        sps_sparse = run(True)
+        sps_dense = run(False)
+    finally:
+        _cfg.set("embedding.sharded", True)
+    common = {"mode": "dlrm_embedding", "vocab": VOCAB, "dim": DIM,
+              "batch": BATCH, "slots": SLOTS, "steps": steps,
+              "unique_ratio": round(unique_ratio, 4)}
+    runs_out.append(dict(common, path="sparse",
+                         samples_s=round(sps_sparse, 1)))
+    runs_out.append(dict(common, path="dense",
+                         samples_s=round(sps_dense, 1)))
+    runs_out.append({"mode": "dlrm_embedding", "path": "speedup",
+                     "sparse_over_dense":
+                         round(sps_sparse / sps_dense, 3)})
+
+
 def serving_config(runs_out, requests):
     """Secondary: mx.serving continuous batching vs sequential batch-1
     predict, requests/s under concurrent load.
@@ -769,6 +840,18 @@ def _summarize(runs):
             "unit": "samples/s",
             "device_over_host":
                 ip_runs.get("overlap", {}).get("device_over_host"),
+        }
+    emb_runs = {r.get("path"): r for r in runs
+                if r.get("mode") == "dlrm_embedding"}
+    if "sparse" in emb_runs and "dense" in emb_runs:
+        secondary["dlrm_embedding_throughput"] = {
+            "sparse_samples_s": emb_runs["sparse"]["samples_s"],
+            "dense_samples_s": emb_runs["dense"]["samples_s"],
+            "unit": "samples/s",
+            "sparse_over_dense":
+                emb_runs.get("speedup", {}).get("sparse_over_dense"),
+            "unique_ratio": emb_runs["sparse"].get("unique_ratio"),
+            "vocab": emb_runs["sparse"].get("vocab"),
         }
     srv_runs = {r.get("path"): r for r in runs
                 if r.get("mode") == "serving"}

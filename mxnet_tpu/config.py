@@ -488,6 +488,39 @@ register_knob(
     "how long an OPEN mx.serving circuit breaker rejects before "
     "transitioning to half-open and letting one probe batch through.")
 
+# sharded embeddings (docs/PERF_NOTES.md "Sharded embeddings")
+register_knob(
+    "embedding.sharded", "MXNET_TPU_EMBEDDING_SHARDED", bool, True,
+    "route trainable sparse-grad embedding tables "
+    "(gluon.nn.Embedding(sparse_grad=True)) through the mesh-sharded "
+    "deduplicated row-sparse lookup/update path (parallel/embedding.py) "
+    "inside SPMDTrainer's fused step: table sharded on the vocab axis, "
+    "ids deduplicated per batch, only touched rows of the table and "
+    "optimizer state rewritten. False = dense gradients + dense "
+    "optimizer step (the full-table-gradient baseline bench.py's "
+    "dlrm_embedding_throughput measures against). Read when a trainer "
+    "is constructed/materialized.")
+register_knob(
+    "embedding.unique_size", "MXNET_TPU_EMBEDDING_UNIQUE_SIZE", int, 0,
+    "static per-batch unique-id capacity for the deduplicated embedding "
+    "lookup (the size= of jnp.unique, so compiled shapes stay flat). "
+    "0 (default) = the batch's id count, which is always safe; a "
+    "positive cap shrinks the gathered buffers but ids beyond the cap "
+    "are DROPPED — only set it when the per-batch unique count is known "
+    "to be bounded. Read at program-build time.")
+
+
+def _apply_embedding_unique_size(value):
+    if int(value) < 0:
+        # reject at set() time and revert (the nanguard pattern): a
+        # negative capacity would crash program build much later
+        _OVERRIDES.pop("embedding.unique_size", None)
+        raise ValueError("embedding.unique_size must be >= 0, got %r"
+                         % (value,))
+
+
+_ON_SET["embedding.unique_size"] = _apply_embedding_unique_size
+
 # bench / testing
 register_knob(
     "bench.timeout_s", "MXTPU_BENCH_TIMEOUT", float, 1650.0,

@@ -454,17 +454,27 @@ def pick_bucket(buckets, n):
     return next((b for b in buckets if b >= n), None)
 
 
-def pad_rows_to(arr, target):
-    """Wrap-pad ``arr`` along axis 0 up to ``target`` rows — the
-    NDArrayIter roll-over semantics, so fill rows hold real (repeated)
-    samples and stay in-distribution for unmasked consumers.  Accepts
-    numpy, jax arrays or NDArray; returns the same flavor it was given
-    (host numpy stays host-side)."""
+def pad_rows_to(arr, target, fill=None):
+    """Pad ``arr`` along axis 0 up to ``target`` rows.
+
+    Default is wrap-padding — the NDArrayIter roll-over semantics, so fill
+    rows hold real (repeated) samples and stay in-distribution for unmasked
+    consumers.  With ``fill`` set, pad rows are that CONSTANT instead: the
+    sentinel-id contract for integer index batches feeding sharded
+    embeddings (docs/PERF_NOTES.md) — a sentinel >= the table's row count
+    is masked out of both the lookup and the row-sparse update, so padded
+    positions never gather real rows or touch the table.  Accepts numpy,
+    jax arrays or NDArray; returns the same flavor it was given (host
+    numpy stays host-side)."""
     raw = arr._data if isinstance(arr, NDArray) else arr
     host = _np.asarray(raw)
     n = host.shape[0]
-    idx = _np.arange(target - n) % max(n, 1)
-    out = _np.concatenate([host, host[idx]], axis=0)
+    if fill is None:
+        idx = _np.arange(target - n) % max(n, 1)
+        tail = host[idx]
+    else:
+        tail = _np.full((target - n,) + host.shape[1:], fill, host.dtype)
+    out = _np.concatenate([host, tail], axis=0)
     return _wrap(jnp.asarray(out)) if isinstance(arr, NDArray) else out
 
 
@@ -622,7 +632,7 @@ class DevicePrefetcher(DataIter):
     """
 
     def __init__(self, iters, placement=None, depth=None, buckets=None,
-                 rename_data=None, rename_label=None):
+                 rename_data=None, rename_label=None, pad_sentinel=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
@@ -633,6 +643,7 @@ class DevicePrefetcher(DataIter):
             buckets = _config.get("io.pad_buckets")
         self.iters = iters
         self._placement = placement
+        self._pad_sentinel = pad_sentinel
         self._buckets = _bucket_sizes(buckets, self.batch_size)
         # Concurrency discipline (lock-checked by tools/mxlint.py): the
         # worker closes over snapshots of _stop/_queue/put; _seen_shapes
@@ -656,7 +667,15 @@ class DevicePrefetcher(DataIter):
 
     def _pad_rows(self, arr, target):
         """Instance seam over the shared :func:`pad_rows_to` (tests
-        monkeypatch it to exercise the fallback path)."""
+        monkeypatch it to exercise the fallback path).  With
+        ``pad_sentinel`` set, INTEGER-dtype arrays (embedding id batches)
+        pad with the sentinel id instead of wrapped rows — the pad-masked
+        loss discards those rows either way, but sentinel ids additionally
+        never gather from or write to a sharded embedding table."""
+        raw = arr._data if type(arr) is NDArray else arr
+        if self._pad_sentinel is not None \
+                and _np.issubdtype(_np.asarray(raw).dtype, _np.integer):
+            return pad_rows_to(arr, target, fill=self._pad_sentinel)
         return pad_rows_to(arr, target)
 
     def _pad_to_bucket(self, batch):

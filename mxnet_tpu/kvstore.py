@@ -224,20 +224,30 @@ class KVStore:
         ids = row_ids if isinstance(row_ids, (list, tuple)) else \
             [row_ids] * len(outs)
         from .ndarray.sparse import RowSparseNDArray
+        from . import telemetry as _telemetry
         for k, o, rid in zip(keys, outs, ids):
             stored = self._store[k]
             src = stored._data if hasattr(stored, "_data") else \
                 jnp.asarray(stored)
             rows = jnp.asarray(rid._data if hasattr(rid, "_data")
                                else rid).astype(jnp.int32).ravel()
+            # deduplicate repeated row_ids BEFORE the gather (reference:
+            # kvstore_local.h:354 Unique on the pull keys): each distinct
+            # row crosses the store boundary once; duplicates are restored
+            # on output through the inverse map — a cheap [K]-row gather
+            uniq, inv = jnp.unique(rows, return_inverse=True)
+            dup = int(rows.shape[0]) - int(uniq.shape[0])
+            if dup:
+                _telemetry.counter("kvstore.rowsparse_dedup_rows").inc(dup)
+            gathered = src[uniq]
             if isinstance(o, RowSparseNDArray):
                 # sparse out: only the K requested rows are gathered and
                 # stored — no dense image is built on either side
-                o._set_rows(rows, src[rows].astype(o.dtype))
+                o._set_rows(rows, gathered[jnp.ravel(inv)].astype(o.dtype))
                 continue
-            gathered = jnp.zeros_like(src).at[rows].set(src[rows])
-            o._set_data(gathered.astype(o._data.dtype)) \
-                if hasattr(o, "_set_data") else setattr(o, "_data", gathered)
+            dense = jnp.zeros_like(src).at[uniq].set(gathered)
+            o._set_data(dense.astype(o._data.dtype)) \
+                if hasattr(o, "_set_data") else setattr(o, "_data", dense)
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

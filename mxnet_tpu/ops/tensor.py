@@ -14,6 +14,21 @@ from jax import lax
 
 from .registry import register
 
+# Active sparse-embedding routing context (parallel/embedding.py
+# SparseLookupContext), installed for the duration of ONE fused-step trace
+# via set_embed_context().  Thread-local: trainer traces on one thread never
+# see a context installed by another (no shared mutable state, no lock).
+import threading as _threading  # noqa: E402
+_EMBED_ROUTE = _threading.local()
+
+
+def set_embed_context(ctx):
+    """Install ``ctx`` as this thread's Embedding routing context; returns
+    the previous one so callers can restore it in a ``finally``."""
+    prev = getattr(_EMBED_ROUTE, "ctx", None)
+    _EMBED_ROUTE.ctx = ctx
+    return prev
+
 # ---------------------------------------------------------------- arithmetic
 
 def _bin(name, fn, aliases=()):
@@ -366,7 +381,16 @@ def _take(a, indices, axis=0, mode="clip", **_):
 
 
 @register("Embedding", aliases=("embedding",))
-def _embedding(data, weight, input_dim=None, output_dim=None, **_):
+def _embedding(data, weight, input_dim=None, output_dim=None,
+               sparse_grad=False, **_):
+    ctx = getattr(_EMBED_ROUTE, "ctx", None)
+    if ctx is not None and sparse_grad:
+        # mesh-sharded deduplicated lookup (parallel/embedding.py): active
+        # only inside an SPMDTrainer fused-step trace; returns None for
+        # weights the context does not route (dense gather below)
+        out = ctx.lookup(data, weight)
+        if out is not None:
+            return out
     idx = _as_index(data)
     return jnp.take(weight, idx, axis=0)
 

@@ -205,6 +205,8 @@ class Optimizer:
             # lazy sparse update (reference optimizer.py:524+): ONLY the
             # rows present in the gradient are touched — stale rows see no
             # weight decay and no momentum decay
+            from .. import telemetry as _telemetry
+            _telemetry.counter("optimizer.lazy_row_updates").inc()
             grad._refresh_sparse()
             rows = grad._indices
             vals = self._preprocess_grad(grad._values)

@@ -25,6 +25,8 @@ from .trainer import SPMDTrainer, build_train_step
 from .pipeline import (pipeline_apply, pipeline_sharded, microbatch,
                        unmicrobatch)
 from .moe import moe_ffn, moe_ffn_sharded, top_k_routing
+from .embedding import (ShardedEmbedding, dedup_ids, lookup_unique,
+                        update_unique)
 
 __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "shard_batch", "replicated", "Mesh", "NamedSharding",
@@ -33,7 +35,9 @@ __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "SPMDTrainer", "build_train_step", "host_allreduce",
            "initialize", "ensure_initialized", "barrier",
            "pipeline_apply", "pipeline_sharded", "microbatch",
-           "unmicrobatch", "moe_ffn", "moe_ffn_sharded", "top_k_routing"]
+           "unmicrobatch", "moe_ffn", "moe_ffn_sharded", "top_k_routing",
+           "ShardedEmbedding", "dedup_ids", "lookup_unique",
+           "update_unique"]
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):

@@ -139,6 +139,15 @@ class SPMDTrainer:
         from .. import config as _cfg
         self._hwio = _cfg.get("conv.weights_layout") == "HWIO"
         self._hwio_names = _conv_weight_names(block) if self._hwio else set()
+        # sparse-grad embedding tables (gluon.nn.Embedding(sparse_grad=True))
+        # route through the mesh-sharded deduplicated row-sparse path
+        # (parallel/embedding.py) when embedding.sharded is on: the table is
+        # sharded on the vocab axis, lookups dedup ids per batch, and the
+        # update touches only the gathered rows via Optimizer.step_rows —
+        # all inside the same donated program as the dense step
+        from . import embedding as _pemb
+        self._sparse_embed = _pemb.sparse_embedding_params(
+            self.fn, self.mesh, self.batch_axis)
 
     def _materialize(self, data):
         """Snapshot the Block's parameters into device-placed jax arrays.
@@ -157,6 +166,9 @@ class SPMDTrainer:
             self.block(_wrap(jnp.asarray(data)))
             self.fn = functionalize(self.block)
             vals = self.fn.init_values()
+            from . import embedding as _pemb
+            self._sparse_embed = _pemb.sparse_embedding_params(
+                self.fn, self.mesh, self.batch_axis)
         if self._hwio:
             # the HWIO flag flips the interpretation of EVERY traced conv
             # weight, but only nn.Conv2D weights were converted: a custom
@@ -217,6 +229,13 @@ class SPMDTrainer:
 
     # ------------------------------------------------------------ placement
     def _spec_for(self, name):
+        se = self._sparse_embed.get(name)
+        if se is not None and se["axis"] is not None \
+                and name not in self._param_specs:
+            # embedding table sharded on the VOCAB axis: each device holds
+            # rows [k*rows_per_shard, (k+1)*rows_per_shard) and its slice
+            # of the optimizer state — no replica of the full table exists
+            return P(se["axis"])
         spec = self._param_specs.get(name, P())  # default: replicated
         if name in self._hwio_names and len(spec) > 0:
             # user specs are written against the OIHW axis order; permute
@@ -249,6 +268,10 @@ class SPMDTrainer:
 
     # ------------------------------------------------------------ step build
     def _build(self, pad=0):
+        sparse_meta = {n: m for n, m in self._sparse_embed.items()
+                       if n in self.fn.trainable}
+        if sparse_meta:
+            return self._build_sparse(pad, sparse_meta)
         masked = pad > 0
         fn = self.fn
         loss_fn = self.loss_fn
@@ -334,6 +357,143 @@ class SPMDTrainer:
         self._batch_sharding = batch_sh
         del param_sh
         donate = (0, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _build_sparse(self, pad, sparse_meta):
+        """Fused step for models with sparse-grad embedding tables.
+
+        Same program shape as `_build` (one donated jit: forward, backward,
+        update, optional nanguard fold) with the row-sparse embedding path
+        spliced in (parallel/embedding.py):
+
+        - tables enter the loss as NON-differentiated arguments; a zero
+          ``delta`` leaf of shape ``[capacity, dim]`` is added to the
+          gathered unique rows, so ``jax.grad`` w.r.t. the deltas yields the
+          DEDUPLICATED per-row gradients and never a dense table cotangent;
+        - the op-level routing context performs the ``jnp.unique(size=)``
+          dedup + shard_map gather (ids recorded through the loss aux);
+        - the update applies ``Optimizer.step_rows`` per shard, touching
+          only the gathered rows of the table and its optimizer state.
+
+        Capacity is ``data.size`` (a batch cannot reference more distinct
+        ids than it has elements; ``embedding.unique_size`` caps it), so
+        compiled shapes — and ``fused_compiles`` — stay flat across ragged
+        index batches padded to a common bucket.
+        """
+        masked = pad > 0
+        fn = self.fn
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        trainable = fn.trainable
+        mesh = self.mesh
+        batch_sh = self.batch_sharding
+        cdt = self.compute_dtype
+        hwio = bool(self._hwio_names)
+        from . import embedding as _pemb
+        sparse_names = [n for n in trainable if n in sparse_meta]
+        if not getattr(optimizer, "lazy_update", False) \
+                or not hasattr(optimizer, "step_rows"):
+            raise ValueError(
+                "sparse-grad embedding params %s need an optimizer with a "
+                "lazy step_rows path (sgd, adam); %r has none — set config "
+                "embedding.sharded=False to train them densely"
+                % (sparse_names, type(optimizer).__name__))
+
+        def loss_of(train_params, emb_deltas, aux_params, emb_tables, data,
+                    label, key):
+            from ..ops import nn as _nn_ops
+            from ..ops import tensor as _tensor_ops
+            param_map = dict(aux_params)  # aux (BN stats) stay f32
+            if cdt is not None:
+                param_map.update(
+                    {n: v.astype(cdt) if v.dtype == jnp.float32 else v
+                     for n, v in train_params.items()})
+                param_map.update(
+                    {n: v.astype(cdt) if v.dtype == jnp.float32 else v
+                     for n, v in emb_tables.items()})
+                if data.dtype == jnp.float32:  # int inputs (token ids) keep
+                    data = data.astype(cdt)    # their dtype
+            else:
+                param_map.update(train_params)
+                param_map.update(emb_tables)
+            ctx = _pemb.SparseLookupContext(mesh, sparse_meta, emb_deltas)
+            prev = _nn_ops.set_hwio_weights(hwio)
+            prev_ctx = _tensor_ops.set_embed_context(ctx)
+            try:
+                (out,), new_aux = fn.apply(param_map, (data,), key,
+                                           training=True)
+            finally:
+                _tensor_ops.set_embed_context(prev_ctx)
+                _nn_ops.set_hwio_weights(prev)
+            if cdt is not None:
+                out = out.astype(jnp.float32)
+            if masked:
+                loss = _as_masked_scalar_loss(loss_fn, out, label, pad)
+            else:
+                loss = _as_scalar_loss(loss_fn, out, label)
+            return loss, (new_aux, out, ctx.records)
+
+        guard = self._guard_mode
+
+        def step(train_params, aux_params, opt_state, emb_tables, data,
+                 label, key, t, lrs, wds, lr_scale, streak=None):
+            cap = _pemb.unique_capacity(int(data.size))
+            ddt = cdt if cdt is not None else None
+            deltas = {
+                n: jnp.zeros((cap, sparse_meta[n]["dim"]),
+                             ddt or emb_tables[n].dtype)
+                for n in sparse_names}
+            (loss, (new_aux, _, recs)), (grads, dgrads) = jax.value_and_grad(
+                loss_of, argnums=(0, 1), has_aux=True)(
+                    train_params, deltas, aux_params, emb_tables, data,
+                    label, key)
+            new_params = {}
+            new_state = {}
+            from .. import random as _random
+            with _random.trace_key_scope(jax.random.fold_in(key, 1)):
+                for i, n in enumerate(trainable):
+                    if n in sparse_meta:
+                        uniq = recs.get(n)
+                        if uniq is None:
+                            # table never looked up this forward: no rows
+                            # to touch (the lazy-update contract)
+                            new_params[n] = emb_tables[n]
+                            new_state[n] = opt_state[n]
+                            continue
+                        gv = _preprocess(
+                            optimizer,
+                            dgrads[n].astype(emb_tables[n].dtype))
+                        w, s = _pemb.update_unique(
+                            optimizer, emb_tables[n], opt_state[n], uniq,
+                            gv, lrs[i] * lr_scale, wds[i], t,
+                            mesh if sparse_meta[n]["axis"] else None,
+                            sparse_meta[n]["axis"])
+                        new_params[n] = w.astype(emb_tables[n].dtype)
+                        new_state[n] = s
+                        continue
+                    w, s = optimizer.step(train_params[n],
+                                          _preprocess(optimizer, grads[n]),
+                                          opt_state[n], lrs[i] * lr_scale,
+                                          wds[i], t)
+                    new_params[n] = w.astype(train_params[n].dtype)
+                    new_state[n] = s
+            aux_out = dict(aux_params)
+            aux_out.update(new_aux)
+            if not guard:
+                return new_params, aux_out, new_state, loss
+            from .. import resilience as _resilience
+            finite = _resilience.all_finite(loss, grads, dgrads)
+            new_streak = _resilience.guarded_streak(finite, streak, "spmd")
+            old_params = dict(train_params)
+            old_params.update(emb_tables)
+            new_params = _resilience.select_tree(finite, new_params,
+                                                 old_params)
+            new_state = _resilience.select_tree(finite, new_state, opt_state)
+            aux_out = _resilience.select_tree(finite, aux_out, aux_params)
+            return new_params, aux_out, new_state, loss, new_streak
+
+        self._batch_sharding = batch_sh
+        donate = (0, 2, 3) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------ public
@@ -427,7 +587,10 @@ class SPMDTrainer:
                                      self._hyper_cache)
         from .. import random as _random
         key = _random.new_eager_seed_key()
-        train = {n: self.params[n] for n in self.fn.trainable}
+        sparse = {n for n in self._sparse_embed if n in self.fn.trainable}
+        train = {n: self.params[n] for n in self.fn.trainable
+                 if n not in sparse}
+        tables = {n: self.params[n] for n in sparse}
         aux = {n: self.params[n] for n in self.fn.aux}
         scales = self._hyper_cache.setdefault("scales", {})
         # cache only plain-number scales (arrays are unhashable and a
@@ -438,21 +601,31 @@ class SPMDTrainer:
             sarr = jnp.asarray(lr_scale, jnp.float32)
             if cacheable and len(scales) < 16:
                 scales[lr_scale] = sarr
+        t_arr = jnp.asarray(self._step_num, jnp.int32)
+        args = (train, aux, self.opt_state) + \
+            ((tables,) if sparse else ()) + (data, label, key, t_arr, lrs,
+                                             wds, sarr)
         if self._guard_mode:
             if self._nan_streak is None:
                 self._nan_streak = jnp.zeros((), jnp.int32)
             new_train, new_aux, self.opt_state, loss, self._nan_streak = \
-                jitted(train, aux, self.opt_state, data, label, key,
-                       jnp.asarray(self._step_num, jnp.int32), lrs,
-                       wds, sarr, self._nan_streak)
+                jitted(*args, self._nan_streak)
             # no-sync host inspection of completed steps' streaks
             _resilience.watch_streak("spmd", self._nan_streak)
         else:
-            new_train, new_aux, self.opt_state, loss = jitted(
-                train, aux, self.opt_state, data, label, key,
-                jnp.asarray(self._step_num, jnp.int32), lrs, wds, sarr)
+            new_train, new_aux, self.opt_state, loss = jitted(*args)
         from .. import profiler as _profiler
         _profiler.counter_increment("fused_steps")
+        if sparse:
+            # static per-step accounting (no device sync): each routed table
+            # gathers/touches at most `capacity` unique rows this step; the
+            # data-dependent unique_ratio gauge is fed by the eager
+            # ShardedEmbedding API and the bench/check tools
+            from . import embedding as _pemb
+            from .. import telemetry as _telemetry
+            cap = _pemb.unique_capacity(int(data.size)) * len(tables)
+            _telemetry.counter("embedding.gathered_rows").inc(cap)
+            _telemetry.counter("embedding.rows_touched").inc(cap)
         self.params = {}
         self.params.update(new_train)
         self.params.update(new_aux)
