@@ -218,7 +218,10 @@ def run_bench(runs_out):
             (out,), _ = fn.apply(pm, (data,), key=None, training=False)
             return out.astype(jnp.float32)
 
-        jfwd = jax.jit(fwd)
+        # registry-wrapped so the run record carries cost_analysis-derived
+        # FLOPs next to the analytic 4.1e9/img estimate
+        jfwd = mx.perf.wrap(jax.jit(fwd), "bench",
+                            "infer/b%d/%s" % (batch, dtype or "float32"))
         data = jnp.asarray(rng.uniform(size=(batch, 3, 224, 224)),
                            jnp.float32)
         out = jfwd(params, data)
@@ -230,7 +233,7 @@ def run_bench(runs_out):
         dt = time.perf_counter() - t0
         img_s = batch * iters / dt
         fwd_tflops = img_s * 4.1e9 / 1e12  # fwd-only FLOPs
-        runs_out.append({
+        rec = {
             "dtype": dtype or "float32", "batch": batch, "iters": iters,
             "mode": "inference", "img_s": round(img_s, 2),
             "tflops": round(fwd_tflops, 2), "peak_tflops": peak,
@@ -238,7 +241,35 @@ def run_bench(runs_out):
             "mfu": round(fwd_tflops / peak, 4),
             "ref_note": "reference inference: 1233 img/s fp32 / 2355 "
                         "img/s fp16 @BS128 V100 (perf.md:196,210)",
-        })
+        }
+        _measured_cost(rec, "bench", batch, img_s, 4.1e9, peak)
+        runs_out.append(rec)
+
+    def _measured_cost(rec, family, batch, img_s, analytic_per_img, peak):
+        """flops_measured/mfu_measured from the newest mx.perf program in
+        ``family`` (XLA cost_analysis, captured at compile); a >10%
+        analytic-vs-measured gap is flagged in the run note.  None fields
+        when no hooked program registered (backend without cost data)."""
+        prog = None
+        try:
+            progs = mx.perf.programs(family)
+            prog = progs[-1] if progs else None
+        except Exception:  # noqa: BLE001 — measurement must not kill bench
+            prog = None
+        if not prog or not prog.get("flops"):
+            rec["flops_measured"] = None
+            rec["mfu_measured"] = None
+            return
+        per_img = prog["flops"] / batch
+        rec["flops_measured"] = round(per_img, 1)
+        rec["mfu_measured"] = round(img_s * per_img / 1e12 / peak, 4)
+        gap = abs(per_img - analytic_per_img) / analytic_per_img
+        if gap > 0.10:
+            note = ("analytic %.3g vs measured %.3g FLOPs/img: %.0f%% "
+                    "discrepancy — trust mfu_measured"
+                    % (analytic_per_img, per_img, 100 * gap))
+            rec["note"] = ("%s; %s" % (rec["note"], note)
+                           if rec.get("note") else note)
 
     def one_config(batch, dtype, iters, layout="native"):
         # layout: "native" | "NHWC" | "NHWC_HWIO" (channels-last weights
@@ -284,6 +315,7 @@ def run_bench(runs_out):
         if dtype is None:
             rec["note"] = ("fp32 has no MXU path on TPU; mfu is vs the "
                            "bf16 peak for comparability")
+        _measured_cost(rec, "spmd", batch, img_s, TRAIN_FLOPS_PER_IMG, peak)
         runs_out.append(rec)
         return rec
 

@@ -49,6 +49,7 @@ _LAZY = {
     "telemetry": ".telemetry",
     "tracing": ".tracing",
     "resilience": ".resilience",
+    "perf": ".perf",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "parallel": ".parallel",

@@ -269,6 +269,34 @@ _ON_SET["tracing.watchdog"] = _apply_tracing_watchdog
 _ON_SET["tracing.watchdog_dir"] = _apply_tracing_watchdog_dir
 _ON_SET["tracing.ring_size"] = _apply_tracing_ring
 
+# compiled-program cost attribution (docs/OBSERVABILITY.md)
+register_knob(
+    "perf.profile", "MXNET_TPU_PROFILE", str, "",
+    "periodic device-trace auto-capture: 'step:N' runs one full train "
+    "step under a jax.profiler trace every N completed steps (written "
+    "under perf.profile_dir, folded with the chrome span sink through "
+    "tools/trace_merge.py when tracing.sink is active). Empty (default) "
+    "disables — the mx.perf step hook then costs one gauge update.")
+register_knob(
+    "perf.profile_dir", "MXNET_TPU_PROFILE_DIR", str, "",
+    "directory for MXNET_TPU_PROFILE step captures (one "
+    "perf_step_<source>_<n>/ subdir per capture); empty (default) = the "
+    "current working directory.")
+
+
+def _apply_perf_profile(value):
+    from . import perf
+    try:
+        perf.configure_profile(value)
+    except ValueError:
+        # reject at set() time and revert (the nanguard pattern): a typo'd
+        # spec must not linger as the stored override
+        _OVERRIDES.pop("perf.profile", None)
+        raise
+
+
+_ON_SET["perf.profile"] = _apply_perf_profile
+
 # fault tolerance (docs/RESILIENCE.md)
 register_knob(
     "resilience.nanguard", "MXNET_TPU_NANGUARD", str, "",

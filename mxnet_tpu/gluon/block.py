@@ -541,7 +541,13 @@ class _CachedGraph:
             # evict programs compiled under superseded knob epochs
             self._jitted = {k: v for k, v in self._jitted.items()
                             if k[1] == key[1]}
-            self._jitted[key] = self._build(training)
+            from .. import perf as _perf
+            # check_tracers: taped calls run inside jax.vjp — those inline
+            # into the outer trace via the plain jit fn, unaccounted
+            self._jitted[key] = _perf.wrap(
+                self._build(training), "gluon",
+                "%s/train=%s/e%d" % (self.block.name, training, key[1]),
+                source="gluon", check_tracers=True)
         fn = self._jitted[key]
         self._ensure_params()
         params = self.params

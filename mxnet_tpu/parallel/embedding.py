@@ -320,6 +320,12 @@ class ShardedEmbedding:
                                      gsum.astype(table.dtype), lr, wd, t,
                                      mesh, self.axis)
             prog = jax.jit(run, donate_argnums=(0, 1))
+        from .. import perf as _perf
+        # no source: embedding programs run inside the caller's step scope
+        # (or eagerly) — cost registers, step MFU attribution stays with
+        # the owning trainer's fused program
+        prog = _perf.wrap(prog, "embedding",
+                          "%s/%s" % (kind, ids_shape))
         self._progs[(kind, ids_shape)] = prog
         return prog
 

@@ -563,8 +563,11 @@ class SPMDTrainer:
         jitted = self._jitted.get(pad)
         if jitted is None:
             self._guard_mode = guard
+            from .. import perf as _perf
             with _tracing.span("spmd.compile", cat="spmd"):
-                jitted = self._jitted[pad] = self._build(pad)
+                jitted = self._jitted[pad] = _perf.wrap(
+                    self._build(pad), "spmd",
+                    "pad=%d/guard=%s" % (pad, guard), source="spmd")
             from .. import profiler as _profiler
             _profiler.counter_increment("fused_compiles")
         # the batch shard_put is the host->mesh boundary; the gradient

@@ -293,7 +293,7 @@ class _ModelEntry:
 
     __slots__ = ("name", "prefix", "predictor", "buckets", "programs",
                  "item_shape", "in_dtype", "breaker", "shed",
-                 "deadline_exceeded", "quantized")
+                 "deadline_exceeded", "quantized", "cost_per_item")
 
     def __init__(self, name, prefix, predictor, buckets):
         self.name = name
@@ -309,6 +309,7 @@ class _ModelEntry:
         self.breaker = None   # assigned by Server.register
         self.shed = 0
         self.deadline_exceeded = 0
+        self.cost_per_item = None  # set by _compile from cost_analysis
 
     @property
     def capacity(self):
@@ -511,10 +512,38 @@ class Server:
         t0 = _time.perf_counter()
         with _tracing.span("serving.compile", cat="serving",
                            model=entry.name, bucket=bucket):
-            program = fn.lower(pspec, xspec).compile()
+            traced = fn.trace(pspec, xspec)
+            t1 = _time.perf_counter()
+            lowered = traced.lower()
+            t2 = _time.perf_counter()
+            program = lowered.compile()
+            t3 = _time.perf_counter()
         _telemetry.counter("serving.compiles").inc()
         _telemetry.timer("serving.compile_ms").observe(
             (_time.perf_counter() - t0) * 1e3)
+        from . import perf as _perf
+        rec = _perf.register_compiled(
+            "serving", "%s/b%d" % (entry.name, bucket), program,
+            phases_ms={"trace_ms": (t1 - t0) * 1e3,
+                       "lower_ms": (t2 - t1) * 1e3,
+                       "compile_ms": (t3 - t2) * 1e3},
+            dtype=str(entry.in_dtype))
+        if rec is not None and rec["flops"] > 0:
+            # per-request cost from the largest bucket compiled so far —
+            # its amortization is what a full batch actually achieves
+            prev = entry.cost_per_item
+            if prev is None or bucket >= prev["bucket"]:
+                entry.cost_per_item = {
+                    "flops": rec["flops"] / bucket,
+                    "bytes": rec["bytes_accessed"] / bucket,
+                    "bucket": bucket,
+                }
+                _telemetry.gauge(
+                    "serving.flops_per_request.%s" % entry.name).set(
+                    round(entry.cost_per_item["flops"], 1))
+                _telemetry.gauge(
+                    "serving.bytes_per_request.%s" % entry.name).set(
+                    round(entry.cost_per_item["bytes"], 1))
         return program
 
     # --------------------------------------------------------- lifecycle
@@ -1009,6 +1038,7 @@ class Server:
         # tools/telemetry_report.py folds these into the serving table,
         # the queue-delay anomaly and the overload-shedding anomaly
         if _telemetry.enabled():
+            cost = entry.cost_per_item
             _telemetry.log_event(
                 "serving", model=entry.name, requests=len(batch),
                 rows=rows, bucket=bucket, quantized=entry.quantized,
@@ -1019,6 +1049,12 @@ class Server:
                 budget_ms=self.max_queue_delay_ms,
                 shed=entry.shed,
                 deadline_exceeded=entry.deadline_exceeded,
+                # useful work in this dispatch, from the registered
+                # program's compile-time cost analysis (mx.perf)
+                flops=round(rows * cost["flops"], 1)
+                if cost is not None else None,
+                bytes=round(rows * cost["bytes"], 1)
+                if cost is not None else None,
                 breaker=breaker.state if breaker is not None else "closed")
 
     # ---------------------------------------------------------- watchdog
@@ -1066,6 +1102,9 @@ class Server:
                         for name, e in self._models.items()}
             quantized = {name: e.quantized
                          for name, e in self._models.items()}
+            cost_per_item = {name: dict(e.cost_per_item)
+                             if e.cost_per_item is not None else None
+                             for name, e in self._models.items()}
             pending = len(self._pending)
             thread = self._thread
         return {
@@ -1077,6 +1116,7 @@ class Server:
                        if k.startswith("serving.")},
             "models": self.models(),
             "quantized": quantized,
+            "cost_per_item": cost_per_item,
             "pending": pending,
             "breakers": breakers,
             "batcher_alive": bool(thread is not None and thread.is_alive()),

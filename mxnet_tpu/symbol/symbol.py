@@ -1127,7 +1127,9 @@ class Executor:
         # donation needs a real accelerator: the CPU backend can't alias
         # donated buffers (it would only warn and copy anyway)
         donate = (0, 1) if jax.default_backend() != "cpu" else ()
-        fn = jax.jit(run, donate_argnums=donate)
+        from .. import perf as _perf
+        fn = _perf.wrap(jax.jit(run, donate_argnums=donate),
+                        "module", key_sig, source="module")
         self._fused_cache[key_sig] = fn
         from .. import profiler as _profiler
         _profiler.counter_increment("fused_compiles")

@@ -66,6 +66,11 @@ STEP_SOURCES = ("module", "spmd", "gluon")
 #: rather than an import so telemetry never depends on tracing.
 _TRACING_STEP_HOOK = None
 
+#: set by mx.perf at import: called as hook(source, step, wall_s) after
+#: every train step; returns extra step-record fields (flops/mfu) or
+#: None.  Same slot-not-import contract as the tracing hook above.
+_PERF_STEP_HOOK = None
+
 #: the PR-1 dispatch counters now live on this registry (profiler.counters()
 #: reads them back from here); listed so snapshots always carry all four
 #: even before the first step.
@@ -393,6 +398,11 @@ class step_scope:
             # watchdog liveness + flight recorder: failures included, so a
             # crash-looping job is distinguishable from a hung one
             hook(self.source, idx, dt, error=error)
+        perf_hook = _PERF_STEP_HOOK
+        # runs with the sink off too: the live perf.mfu gauges (and the
+        # MXNET_TPU_PROFILE cadence) don't depend on JSONL being written
+        perf_fields = (perf_hook(self.source, idx, dt)
+                       if perf_hook is not None else None)
         if self._before is None:
             return False
         # a FAILING step still leaves a JSONL record (with its error) — the
@@ -425,6 +435,10 @@ class step_scope:
             shape=list(self.shape) if self.shape else None,
             mesh=dict(self.mesh) if self.mesh else None,
         )
+        if perf_fields:
+            # achieved FLOPs + model-FLOPs-utilization for this step, from
+            # the mx.perf program registry (compile-time cost analysis)
+            fields.update(perf_fields)
         if error is not None:
             fields["error"] = error
         log_event("step", **fields)
@@ -461,7 +475,8 @@ _STEP_REQUIRED = {"event": str, "ts": (int, float), "source": str,
                   "compiles": int, "host_syncs": int}
 _STEP_OPTIONAL = {"samples": int, "samples_per_s": (int, float),
                   "mem_bytes": int, "shape": list, "mesh": dict,
-                  "h2d_sync": int, "error": str}
+                  "h2d_sync": int, "error": str,
+                  "flops": (int, float), "mfu": (int, float)}
 
 
 def validate_step_record(rec):
@@ -505,3 +520,7 @@ from . import tracing as _tracing  # noqa: E402,F401
 # mx.resilience likewise honors MXNET_TPU_FAULTS / MXNET_TPU_ON_PREEMPT at
 # its import (it only imports config at module scope, so no cycle)
 from . import resilience as _resilience  # noqa: E402,F401
+
+# mx.perf registers the step hook above and honors MXNET_TPU_PROFILE at
+# its import, so any training-path import arms cost attribution
+from . import perf as _perf  # noqa: E402,F401

@@ -27,18 +27,10 @@ sys.path.insert(0, ROOT)
 
 import bench  # noqa: E402  (the probe + constants live there)
 
-
-def classify(op_name):
-    n = op_name.lower()
-    if "conv" in n or "dot" in n or "einsum" in n:
-        return "convolution/matmul"
-    if "reduce" in n or "batchnorm" in n or "norm" in n or "variance" in n:
-        return "reductions (BN statistics)"
-    if "transpose" in n or "copy" in n or "reshape" in n or "bitcast" in n:
-        return "layout/copy"
-    if "all-reduce" in n or "allreduce" in n or "collective" in n:
-        return "collectives"
-    return "other (fused elementwise, optimizer...)"
+# per-op buckets use mx.perf.classify_op — the SAME mapping the program
+# registry's HLO cost table uses, so the two reports cannot drift.  It is
+# imported inside main() after the backend probe (pulling mxnet_tpu here
+# would pull jax in before the probe's watchdog exists, like bench.py).
 
 
 def main():
@@ -134,12 +126,13 @@ def main():
         result["note"] = ("no device plane in trace (cpu backend or trace "
                          "capture unsupported over this tunnel)")
     else:
+        from mxnet_tpu.perf import classify_op
         per_class = {}
         rows = []
         for name, durs in ops.items():
             total = sum(durs)
-            per_class[classify(name)] = \
-                per_class.get(classify(name), 0.0) + total
+            cls = classify_op(name)
+            per_class[cls] = per_class.get(cls, 0.0) + total
             rows.append((total, len(durs), name))
         rows.sort(reverse=True)
         total_all = sum(per_class.values()) or 1.0

@@ -23,6 +23,11 @@ device memory.  Anomaly flags:
     ring fell behind or a batch bypassed staging.  Runs that ALWAYS do
     synchronous H2D (host-side prefetch) are their normal mode, not
     flagged.
+  * MFU collapse — late-window median of the per-step ``mfu`` field
+    (mx.perf cost attribution) below 50% of the run's own early-window
+    median over >= 10 attributed steady steps: the program didn't change
+    (same compiled FLOPs) so the wall time grew — host stalls, input
+    starvation, or contention, not a model change.
 
 ``serving`` records (one per mx.serving batch dispatch) get their own
 per-model table — dispatches, requests, rows, mean batch fill, queue-delay
@@ -56,6 +61,7 @@ THROUGHPUT_DROP = 0.7
 MIN_STEPS_FOR_FLAGS = 10
 QUEUE_DELAY_RATIO = 3.0  # serving p99 queue delay vs the configured budget
 SHED_RATIO = 0.10        # shed / offered load before overload is flagged
+MFU_COLLAPSE = 0.5       # late-window MFU median vs the run's own early one
 
 
 def load_records(path):
@@ -108,6 +114,12 @@ def _summarize_serving(serving_recs, anomalies):
                  if isinstance(r.get("fill"), (int, float))]
         requests = sum(int(r.get("requests") or 0) for r in recs)
         rows = sum(int(r.get("rows") or 0) for r in recs)
+        # per-dispatch useful-work fields (mx.perf cost analysis): totals
+        # over the log normalized per row served
+        flops_total = sum(float(r["flops"]) for r in recs
+                          if isinstance(r.get("flops"), (int, float)))
+        bytes_total = sum(float(r["bytes"]) for r in recs
+                          if isinstance(r.get("bytes"), (int, float)))
         buckets = sorted({int(r["bucket"]) for r in recs
                           if isinstance(r.get("bucket"), int)})
         budgets = [float(r["budget_ms"]) for r in recs
@@ -141,6 +153,10 @@ def _summarize_serving(serving_recs, anomalies):
             "shed": shed,
             "deadline_exceeded": deadline,
             "breaker": breaker,
+            "flops_per_request": round(flops_total / rows, 1)
+            if rows and flops_total else None,
+            "bytes_per_request": round(bytes_total / rows, 1)
+            if rows and bytes_total else None,
         }
         # queue delays should sit near the batching budget; a p99 far past
         # it means arrivals outpace dispatch and the queue is backing up.
@@ -200,6 +216,8 @@ def summarize(records):
                         and not r.get("compiles")) or walls
         sps = [float(r["samples_per_s"]) for r in recs
                if isinstance(r.get("samples_per_s"), (int, float))]
+        mfus = [float(r["mfu"]) for r in recs
+                if isinstance(r.get("mfu"), (int, float))]
         compiles = sum(int(r.get("compiles") or 0) for r in recs)
         syncs = sum(int(r.get("host_syncs") or 0) for r in recs)
         h2d_sync = sum(int(r.get("h2d_sync") or 0) for r in recs)
@@ -222,6 +240,7 @@ def summarize(records):
             "wall_ms_p99": round(p99, 3) if p99 is not None else None,
             "samples_per_s_mean": round(sum(sps) / len(sps), 1)
             if sps else None,
+            "mfu_mean": round(sum(mfus) / len(mfus), 6) if mfus else None,
             "compiles": compiles,
             "host_syncs": syncs,
             "sync_h2d": h2d_sync,
@@ -273,6 +292,22 @@ def summarize(records):
                     "detail": "second-half %.1f samples/s vs first-half "
                               "%.1f (< %d%%)" % (second, first,
                                                  THROUGHPUT_DROP * 100)})
+        # MFU collapse: compiled FLOPs per step are constant, so a falling
+        # mfu IS rising wall time — compare the run against its own early
+        # window (compile-step stragglers excluded)
+        steady_mfus = [float(r["mfu"]) for r in recs
+                       if isinstance(r.get("mfu"), (int, float))
+                       and not r.get("compiles")]
+        if len(steady_mfus) >= MIN_STEPS_FOR_FLAGS:
+            k = max(3, len(steady_mfus) // 4)
+            early = _pct(sorted(steady_mfus[:k]), 50)
+            late = _pct(sorted(steady_mfus[-k:]), 50)
+            if early and late is not None and late < MFU_COLLAPSE * early:
+                anomalies.append({
+                    "kind": "mfu_collapse", "source": source,
+                    "detail": "steady-state MFU %.4f vs early-window %.4f "
+                              "(< %d%%): same program, slower steps"
+                              % (late, early, MFU_COLLAPSE * 100)})
 
     serving = _summarize_serving(serving_recs, anomalies)
     return {"sources": sources, "serving": serving, "anomalies": anomalies,
@@ -285,16 +320,18 @@ def _fmt(v, suffix=""):
 
 def render(summary, bad_lines=0):
     lines = []
-    header = ("%-8s %6s %10s %10s %10s %12s %8s %6s %12s %7s"
+    header = ("%-8s %6s %10s %10s %10s %12s %8s %8s %6s %12s %7s"
               % ("source", "steps", "mean_ms", "p50_ms", "p99_ms",
-                 "samples/s", "compile", "syncs", "peak_mem", "shapes"))
+                 "samples/s", "mfu", "compile", "syncs", "peak_mem",
+                 "shapes"))
     lines.append(header)
     lines.append("-" * len(header))
     for source, t in summary["sources"].items():
-        lines.append("%-8s %6d %10s %10s %10s %12s %8d %6d %12s %7d"
+        lines.append("%-8s %6d %10s %10s %10s %12s %8s %8d %6d %12s %7d"
                      % (source, t["steps"], _fmt(t["wall_ms_mean"]),
                         _fmt(t["wall_ms_p50"]), _fmt(t["wall_ms_p99"]),
-                        _fmt(t["samples_per_s_mean"]), t["compiles"],
+                        _fmt(t["samples_per_s_mean"]),
+                        _fmt(t.get("mfu_mean")), t["compiles"],
                         t["host_syncs"], _fmt(t["peak_mem_bytes"]),
                         t["distinct_shapes"]))
         path_str = ", ".join("%s=%d" % kv for kv in
@@ -306,20 +343,24 @@ def render(summary, bad_lines=0):
     serving = summary.get("serving") or {}
     if serving:
         lines.append("")
-        shdr = ("%-10s %9s %9s %7s %6s %10s %10s %9s %9s %5s %5s %9s %s"
+        shdr = ("%-10s %9s %9s %7s %6s %10s %10s %9s %9s %11s %11s "
+                "%5s %5s %9s %s"
                 % ("model", "dispatch", "requests", "rows", "fill",
                    "qd_p50ms", "qd_p99ms", "w_p50ms", "w_p99ms",
-                   "shed", "ddl", "breaker", "buckets"))
+                   "flops/req", "bytes/req", "shed", "ddl", "breaker",
+                   "buckets"))
         lines.append(shdr)
         lines.append("-" * len(shdr))
         for model, t in serving.items():
             lines.append("%-10s %9d %9d %7d %6s %10s %10s %9s %9s "
-                         "%5d %5d %9s %s"
+                         "%11s %11s %5d %5d %9s %s"
                          % (model, t["dispatches"], t["requests"],
                             t["rows"], _fmt(t["fill_mean"]),
                             _fmt(t["queue_delay_ms_p50"]),
                             _fmt(t["queue_delay_ms_p99"]),
                             _fmt(t["wall_ms_p50"]), _fmt(t["wall_ms_p99"]),
+                            _fmt(t.get("flops_per_request")),
+                            _fmt(t.get("bytes_per_request")),
                             t.get("shed", 0), t.get("deadline_exceeded", 0),
                             t.get("breaker") or "-",
                             ",".join(str(b) for b in t["buckets"])))
