@@ -372,6 +372,11 @@ def run_bench(runs_out):
     except Exception as e:  # noqa: BLE001
         runs_out.append({"mode": "quantized_serving",
                          "error": "%s: %s" % (type(e).__name__, e)})
+    try:
+        transformer_kernels_config(runs_out, on_tpu)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "transformer_kernels",
+                         "error": "%s: %s" % (type(e).__name__, e)})
 
     result = _summarize(runs_out)
     result.update(platform=platform, device_kind=kind)
@@ -821,6 +826,170 @@ def quantized_serving_config(runs_out, requests):
                      "int8_over_fp32": round(int8_rps / fp32_rps, 2)})
 
 
+def transformer_kernels_config(runs_out, on_tpu):
+    """Secondary: the mx.kernels tier on the transformer hot path.
+
+    Three paired measurements, every program registered with mx.perf
+    under the "kernels" family so achieved FLOPs come from the
+    compiler's own cost analysis, not hand math:
+
+    * attention — the fused Pallas flash kernel vs the XLA lowering on
+      the same [B,H,S,D] problem, per-op wall ms + achieved GFLOP/s
+      (on CPU the kernel runs in the Pallas interpreter: numerics
+      proven, speed meaningless — the deltas only bind on TPU);
+    * train step — a small TransformerLM Adam step with the tier off
+      vs on (flash attention + fused optimizer epilogue), same seed;
+    * stack tuning — trace+compile ms of the SAME loss program built
+      with runtime.stack_mode=unroll vs scan (perf phases_ms), equal
+      loss required.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _cfg
+    from mxnet_tpu import kernels as _kernels
+    from mxnet_tpu import perf as _perf
+    from mxnet_tpu.models.transformer import (TransformerLM,
+                                              TransformerLMConfig)
+
+    B, H, S, D = (4, 8, 1024, 64) if on_tpu else (1, 2, 128, 32)
+    iters = 20 if on_tpu else 3
+    rng = np.random.RandomState(7)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D), dt) for _ in range(3))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    def attn_row(path, enabled):
+        _cfg.set("kernels.enabled", enabled)
+        key = "attention/%s/b%dh%ds%dd%d" % (path, B, H, S, D)
+        fn = _perf.wrap(
+            jax.jit(lambda q, k, v: _kernels.attention(q, k, v,
+                                                       causal=True)),
+            "kernels", key)
+        ms = timed(fn, q, k, v)
+        rec = _perf.program("kernels", key) or {}
+        row = {"mode": "transformer_kernels", "path": "attention_" + path,
+               "shape": [B, H, S, D], "wall_ms": round(ms, 3)}
+        if rec.get("flops"):
+            row["flops"] = rec["flops"]
+            row["achieved_gflops"] = round(rec["flops"] / (ms / 1e3) / 1e9,
+                                           3)
+        return row
+
+    try:
+        xla_row = attn_row("xla", False)
+        flash_row = attn_row("flash", True)
+        runs_out.append(xla_row)
+        runs_out.append(flash_row)
+
+        # ---- train step, tier off vs on (same seed, Adam)
+        cfg = TransformerLMConfig(vocab_size=256, num_layers=2,
+                                  d_model=4 * D, num_heads=H, d_ff=8 * D,
+                                  max_len=S, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        tok = jnp.asarray(rng.randint(0, 256, (B, S)), jnp.int32)
+        opt = mx.optimizer.create("adam", learning_rate=1e-3)
+
+        def train_row(path, enabled):
+            _cfg.set("kernels.enabled", enabled)
+            params = model.init(jax.random.PRNGKey(11))
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            state = [(jnp.zeros_like(w), jnp.zeros_like(w))
+                     for w in leaves]
+            fused = _kernels.fused_step_enabled(opt)
+
+            def step(leaves, state, t):
+                loss, grads = jax.value_and_grad(
+                    lambda lv: model.loss(
+                        jax.tree_util.tree_unflatten(treedef, lv),
+                        tok, tok))(leaves)
+                new_l, new_s = [], []
+                for w, g, s in zip(leaves, grads, state):
+                    if fused and w.dtype == jnp.float32:
+                        nw, _m, ns = opt.step_fused(
+                            w, g, s, 1e-3, 0.0, t, out_dtype=w.dtype)
+                    else:
+                        nw, ns = opt.step(w, g, s, 1e-3, 0.0, t)
+                        nw = nw.astype(w.dtype)
+                    new_l.append(nw)
+                    new_s.append(ns)
+                return new_l, new_s, loss
+
+            key = "train/kernels=%s" % ("on" if enabled else "off")
+            fn = _perf.wrap(jax.jit(step), "kernels", key)
+            loss = None
+            t0 = time.perf_counter()
+            for i in range(iters):
+                leaves, state, loss = fn(leaves, state, i + 1)
+            jax.block_until_ready(loss)
+            ms = (time.perf_counter() - t0) / iters * 1e3
+            rec = _perf.program("kernels", key) or {}
+            row = {"mode": "transformer_kernels", "path": "train_" + path,
+                   "steps": iters, "step_ms": round(ms, 3),
+                   "loss": float(loss)}
+            if rec.get("flops"):
+                row["flops"] = rec["flops"]
+                row["achieved_gflops"] = round(
+                    rec["flops"] / (ms / 1e3) / 1e9, 3)
+            return row
+
+        t_off = train_row("off", False)
+        t_on = train_row("on", True)
+        runs_out.append(t_off)
+        runs_out.append(t_on)
+        runs_out.append({"mode": "transformer_kernels",
+                         "path": "train_loss_delta",
+                         "abs_delta": round(
+                             abs(t_on["loss"] - t_off["loss"]), 8)})
+
+        # ---- scan vs unroll: trace+compile ms at equal loss
+        _cfg.set("kernels.enabled", False)
+        deep = TransformerLMConfig(vocab_size=256, num_layers=8,
+                                   d_model=64, num_heads=4, d_ff=128,
+                                   max_len=64, dtype=jnp.float32)
+        dmodel = TransformerLM(deep)
+        dparams = dmodel.init(jax.random.PRNGKey(3))
+        dtok = jnp.asarray(rng.randint(0, 256, (2, 64)), jnp.int32)
+        stack = {}
+        for mode in ("unroll", "scan"):
+            _cfg.set("runtime.stack_mode", mode)
+            key = "stack/%s" % mode
+            fn = _perf.wrap(jax.jit(dmodel.loss), "kernels", key)
+            loss = fn(dparams, dtok, dtok)
+            jax.block_until_ready(loss)
+            rec = _perf.program("kernels", key) or {}
+            ph = rec.get("phases_ms", {})
+            build_ms = round(ph.get("trace_ms", 0.0) +
+                             ph.get("compile_ms", 0.0) +
+                             ph.get("lower_ms", 0.0), 1)
+            stack[mode] = {"loss": float(loss), "build_ms": build_ms}
+            runs_out.append({"mode": "transformer_kernels",
+                             "path": "stack_" + mode,
+                             "layers": deep.num_layers,
+                             "build_ms": build_ms,
+                             "phases_ms": ph, "loss": float(loss)})
+        _cfg.set("runtime.stack_mode", "scan")
+        runs_out.append({
+            "mode": "transformer_kernels", "path": "stack_speedup",
+            "unroll_over_scan_build":
+                round(stack["unroll"]["build_ms"] /
+                      max(stack["scan"]["build_ms"], 1e-9), 3),
+            "loss_delta": round(abs(stack["scan"]["loss"] -
+                                    stack["unroll"]["loss"]), 8)})
+    finally:
+        _cfg.set("kernels.enabled", False)
+        _cfg.set("runtime.stack_mode", "scan")
+
+
 def _summarize(runs):
     """One JSON result from the completed sweep configs (best bf16 TRAIN
     run wins — inference runs are reported in `runs` but never headline,
@@ -912,6 +1081,27 @@ def _summarize(runs):
             "int8_over_fp32":
                 q_runs.get("speedup", {}).get("int8_over_fp32"),
             "measured_error": q_runs["int8"].get("measured_error"),
+        }
+    k_runs = {r.get("path"): r for r in runs
+              if r.get("mode") == "transformer_kernels"}
+    if "attention_flash" in k_runs:
+        secondary["transformer_kernels"] = {
+            "attention_flash_gflops":
+                k_runs["attention_flash"].get("achieved_gflops"),
+            "attention_xla_gflops":
+                k_runs["attention_xla"].get("achieved_gflops"),
+            "attention_shape": k_runs["attention_flash"].get("shape"),
+            "train_on_step_ms": k_runs.get("train_on", {}).get("step_ms"),
+            "train_off_step_ms":
+                k_runs.get("train_off", {}).get("step_ms"),
+            "train_loss_delta":
+                k_runs.get("train_loss_delta", {}).get("abs_delta"),
+            "scan_build_ms": k_runs.get("stack_scan", {}).get("build_ms"),
+            "unroll_build_ms":
+                k_runs.get("stack_unroll", {}).get("build_ms"),
+            "unroll_over_scan_build":
+                k_runs.get("stack_speedup", {}).get(
+                    "unroll_over_scan_build"),
         }
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",

@@ -50,6 +50,7 @@ _LAZY = {
     "tracing": ".tracing",
     "resilience": ".resilience",
     "perf": ".perf",
+    "kernels": ".kernels",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "parallel": ".parallel",

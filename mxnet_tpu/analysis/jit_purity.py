@@ -113,6 +113,21 @@ def _is_jit_callee(module, func_node):
     return False
 
 
+def _is_pallas_callee(module, func_node):
+    """``pl.pallas_call`` / ``pallas_call`` call sites — kernel bodies
+    are traced (by Mosaic instead of XLA) with the same purity rules."""
+    d = dotted_name(func_node)
+    if not d:
+        return False
+    if d == "pallas_call":
+        src = module.from_imports.get("pallas_call")
+        return bool(src and src[0].startswith("jax.experimental.pallas"))
+    if d.endswith(".pallas_call"):
+        return _base_module(module, d).startswith(
+            "jax.experimental.pallas")
+    return False
+
+
 def _static_params(call):
     """Parameter names/positions excluded from taint by static_argnums/
     static_argnames on the jit call."""
@@ -451,6 +466,42 @@ class JitPurity(object):
         tainted = self._entry_taint(fn, jit_call)
         self.walk_traced(module, fn, qual, tainted, 0)
 
+    def _handle_kernel_entry(self, module, scopes, parents, call):
+        """pallas_call(kernel, ...) — taint the kernel's Ref params.
+
+        The kernel may arrive as a bare Name/Lambda or wrapped in
+        ``functools.partial(kernel, static0, static1, ...)``: the
+        leading bound arguments are trace-time statics (grid constants
+        like ``causal``/``block_q``), so only the params AFTER them —
+        the VMEM Refs — are tracers.  That keeps ``if causal:``
+        specialization inside kernels legal."""
+        arg = call.args[0]
+        bound = 0
+        if isinstance(arg, ast.Call):
+            d = dotted_name(arg.func)
+            if not (d and d.split(".")[-1] == "partial" and arg.args):
+                return
+            bound = len(arg.args) - 1
+            arg = arg.args[0]
+        fn = None
+        if isinstance(arg, ast.Lambda):
+            fn = arg
+        elif isinstance(arg, ast.Name):
+            anc = parents.get(call)
+            while anc is not None and anc not in scopes:
+                anc = parents.get(anc)
+            sc = scopes.get(anc, scopes[module.tree])[0]
+            fn = sc.lookup(arg.id) if sc else None
+            if fn is None:
+                fn = module.top_funcs.get(arg.id)
+        if fn is None:
+            return
+        qual = scopes[fn][1] if fn in scopes else \
+            getattr(fn, "name", "<lambda>")
+        tainted = set(_param_names(fn)[bound:])
+        if tainted:
+            self.walk_traced(module, fn, qual, tainted, 0)
+
     def _check_donated_reuse(self, module, scopes, enclosing, jit_call):
         """fn = jax.jit(f, donate_argnums=...); fn(a, b); <use of a>."""
         donated = _donated_positions(jit_call)
@@ -507,16 +558,19 @@ class JitPurity(object):
 
     def run(self):
         for module in self.repo.modules:
-            # cheap prefilter: a module with no "jit" token has no entry
-            # points (cross-module helpers are still walked lazily when
-            # a traced body reaches them)
-            if "jit" not in module.text:
+            # cheap prefilter: a module with no "jit" (or kernel-launch)
+            # token has no entry points (cross-module helpers are still
+            # walked lazily when a traced body reaches them)
+            if "jit" not in module.text and \
+                    "pallas_call" not in module.text:
                 continue
             entries = [n for n in ast.walk(module.tree)
                        if isinstance(n, (ast.FunctionDef,
                                          ast.AsyncFunctionDef, ast.Call))]
             if not any(isinstance(n, ast.Call) and
-                       _is_jit_callee(module, n.func) for n in entries) \
+                       (_is_jit_callee(module, n.func) or
+                        _is_pallas_callee(module, n.func))
+                       for n in entries) \
                     and not any(
                         isinstance(n, (ast.FunctionDef,
                                        ast.AsyncFunctionDef))
@@ -573,6 +627,12 @@ class JitPurity(object):
                         anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     anc = parents.get(anc)
                 self._check_donated_reuse(module, scopes, anc, node)
+            # kernel entries: pallas_call(kernel | partial(kernel, ...))
+            for node in entries:
+                if isinstance(node, ast.Call) and node.args and \
+                        _is_pallas_callee(module, node.func):
+                    self._handle_kernel_entry(module, scopes, parents,
+                                              node)
         return self.findings
 
 

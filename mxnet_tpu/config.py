@@ -516,6 +516,81 @@ register_knob(
     "how long an OPEN mx.serving circuit breaker rejects before "
     "transitioning to half-open and letting one probe batch through.")
 
+# Pallas kernel tier (docs/PERF_NOTES.md "Kernel tier")
+register_knob(
+    "kernels.enabled", "MXNET_TPU_KERNELS", bool, False,
+    "route the training hot path through the Pallas kernel tier "
+    "(mx.kernels): fused flash-attention fwd+bwd under the transformer/"
+    "BERT stack and the fused optimizer+cast epilogue inside the fused "
+    "train steps (module fused_step_fn, SPMDTrainer, eager "
+    "multi-precision updates). Shapes/optimizers the kernels cannot "
+    "serve fall back to the XLA lowering per call site "
+    "(kernels.fallback counts them); off (default) keeps every traced "
+    "program byte-identical to the pre-kernel paths. On CPU/GPU the "
+    "kernels run through the Pallas interpreter (same numerics, no "
+    "speedup) — the knob is a TPU performance switch and a CPU parity "
+    "switch.")
+register_knob(
+    "kernels.vmem_budget", "MXNET_TPU_KERNELS_VMEM_BUDGET", int,
+    2097152,  # 2 MiB — a literal, so static doc/drift tooling can read it
+    "per-block VMEM budget in bytes for the Pallas row-block kernels "
+    "(ops/pallas_kernels.py _row_block): block row counts are the "
+    "largest divisor of n_rows whose block fits the budget; flash "
+    "attention also checks one head's full K/V against it before "
+    "engaging. Must be > 0; ~16MB/core is the hardware ceiling, the "
+    "2MB default leaves headroom for double buffering.")
+
+
+def _apply_kernels_vmem_budget(value):
+    if int(value) <= 0:
+        # reject at set() time and revert (the nanguard pattern): a
+        # non-positive budget would degrade every kernel to 1-row blocks
+        # or divide-by-zero much later
+        _OVERRIDES.pop("kernels.vmem_budget", None)
+        raise ValueError("kernels.vmem_budget must be > 0 bytes, got %r"
+                         % (value,))
+
+
+_ON_SET["kernels.vmem_budget"] = _apply_kernels_vmem_budget
+
+# transformer layer-stack program tuning (runtime.scan_stack,
+# docs/PERF_NOTES.md "Kernel tier")
+register_knob(
+    "runtime.stack_mode", "MXNET_TPU_STACK_MODE", str, "scan",
+    "layer-stack program shape for runtime.scan_stack: 'scan' (default) "
+    "traces the layer body ONCE under lax.scan so trace/compile time "
+    "stays flat in depth; 'unroll' inlines every layer (the A/B "
+    "baseline bench.py measures perf.trace_ms/compile_ms against).")
+register_knob(
+    "runtime.remat", "MXNET_TPU_REMAT", str, "",
+    "selective rematerialization wrapped around the scanned layer body "
+    "(runtime.scan_stack): '' (default) saves all residuals — no "
+    "jax.checkpoint, traces identical to pre-knob programs; 'dots' "
+    "keeps matmul outputs and recomputes the cheap elementwise tail in "
+    "the backward (jax.checkpoint_policies dots_saveable); 'full' "
+    "saves nothing — maximum live-memory savings for roughly 1/3 more "
+    "FLOPs.")
+
+
+def _apply_runtime_stack_mode(value):
+    v = (value or "").strip().lower()
+    if v not in ("scan", "unroll"):
+        _OVERRIDES.pop("runtime.stack_mode", None)
+        raise ValueError("runtime.stack_mode must be 'scan' or 'unroll', "
+                         "got %r" % (value,))
+
+
+def _apply_runtime_remat(value):
+    v = (value or "").strip().lower()
+    if v not in ("", "dots", "full"):
+        _OVERRIDES.pop("runtime.remat", None)
+        raise ValueError("runtime.remat must be '', 'dots' or 'full', "
+                         "got %r" % (value,))
+
+
+_ON_SET["runtime.stack_mode"] = _apply_runtime_stack_mode
+_ON_SET["runtime.remat"] = _apply_runtime_remat
+
 # sharded embeddings (docs/PERF_NOTES.md "Sharded embeddings")
 register_knob(
     "embedding.sharded", "MXNET_TPU_EMBEDDING_SHARDED", bool, True,

@@ -1,0 +1,126 @@
+"""mx.kernels — routing tier for the hand-written Pallas kernels.
+
+The raw kernels live in ``ops/pallas_kernels.py`` and stay policy-free;
+this module owns WHEN they run.  Reference analog: the graph optimizer
+deciding when to swap a library op for a hand-fused RTC kernel
+(src/common/rtc.cc + graph passes) — here the decision is an explicit
+config knob plus a shape/platform feasibility check, because silent
+kernel swaps are how frameworks grow haunted performance.
+
+Routing contract (docs/PERF_NOTES.md "Kernel tier"):
+
+* everything is OFF by default — with ``kernels.enabled`` false the
+  routed entry points trace the exact same XLA ops as before the kernel
+  tier existed, so programs are byte-identical;
+* with the knob on, supported shapes go through the Pallas kernel
+  (``kernels.flash_attention`` counter) and unsupported ones fall back
+  to the XLA lowering (``kernels.fallback`` counter) — never an error;
+* the decision is trace-time python, so a jitted program contains one
+  path only and toggling the knob retraces (config epoch / trainer
+  cache keys handle that).
+
+On CPU the kernels run through the Pallas interpreter — same numerics,
+no TPU needed — which is what the parity gates in
+``tools/check_kernels.py`` rely on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import config as _config
+from . import telemetry as _telemetry
+from .ops.pallas_kernels import (flash_attention, fused_adam_step,
+                                 fused_sgd_step)
+
+__all__ = ["enabled", "attention", "flash_unsupported_reason",
+           "fused_step_enabled", "flash_attention", "fused_sgd_step",
+           "fused_adam_step", "measure"]
+
+# one-row VMEM feasibility: a q block keeps its head's full K and V
+# resident, so 2 * Skv * D * itemsize must fit the budget
+_MAX_HEAD_DIM = 512
+
+
+def enabled():
+    """True when the kernel tier is switched on (``kernels.enabled`` /
+    MXNET_TPU_KERNELS)."""
+    return bool(_config.get("kernels.enabled"))
+
+
+def fused_step_enabled(optimizer):
+    """True when ``optimizer`` should update through its fused
+    Pallas epilogue: tier on + the optimizer implements ``step_fused``
+    + its step math is jit-safe."""
+    return (enabled()
+            and getattr(optimizer, "fused_step", False)
+            and getattr(optimizer, "jit_safe", True))
+
+
+def note_fused_step():
+    """Count one fused optimizer-epilogue launch (trace-time — counts
+    program builds, not steps; the per-step signal is the program key)."""
+    _telemetry.counter("kernels.fused_step").inc()
+
+
+def flash_unsupported_reason(q, k, v, causal):
+    """Why flash attention can NOT take this call, or None if it can.
+
+    Trace-time shape/dtype checks only — everything here must be static
+    under jit.  A non-None reason routes to the XLA fallback."""
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        return "rank != 4 (got q%s k%s v%s)" % (q.ndim, k.ndim, v.ndim)
+    if k.shape != v.shape:
+        return "k/v shapes differ: %s vs %s" % (k.shape, v.shape)
+    if q.shape[:2] != k.shape[:2]:
+        return "q/kv batch-head mismatch: %s vs %s" % (
+            q.shape[:2], k.shape[:2])
+    if q.shape[3] != k.shape[3]:
+        return "q/kv head dim mismatch: %d vs %d" % (
+            q.shape[3], k.shape[3])
+    if causal and q.shape[2] != k.shape[2]:
+        return "causal needs Sq == Skv, got %d vs %d" % (
+            q.shape[2], k.shape[2])
+    if q.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return "unsupported dtype %s" % q.dtype
+    if q.shape[3] > _MAX_HEAD_DIM:
+        return "head dim %d > %d" % (q.shape[3], _MAX_HEAD_DIM)
+    # K + V of one (batch, head) slice must fit the per-block VMEM budget
+    kv_bytes = 2 * k.shape[2] * k.shape[3] * k.dtype.itemsize
+    budget = _config.get("kernels.vmem_budget")
+    if kv_bytes > budget:
+        return "kv slice %d bytes > vmem budget %d" % (kv_bytes, budget)
+    return None
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Dot-product attention with kernel routing.
+
+    Tier off → the plain XLA lowering (parallel.ring_attention.attention),
+    traced identically to the pre-kernel-tier program.  Tier on →
+    the fused Pallas flash kernel when the shape qualifies
+    (``kernels.flash_attention`` counter), XLA fallback otherwise
+    (``kernels.fallback`` counter)."""
+    from .parallel.ring_attention import attention as _xla_attention
+    if enabled():
+        q = jnp.asarray(q)
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        reason = flash_unsupported_reason(q, k, v, causal)
+        if reason is None:
+            _telemetry.counter("kernels.flash_attention").inc()
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        _telemetry.counter("kernels.fallback").inc()
+    return _xla_attention(q, k, v, causal=causal, scale=scale)
+
+
+def measure(key, fn, *args):
+    """Register ``fn(*args)`` with mx.perf under the "kernels" family and
+    run it once: returns ``(outputs, program_record)`` where the record
+    carries cost_analysis FLOPs, phase times and the roofline bound.
+    This is how bench/opperf secondaries report achieved FLOPs per op."""
+    from . import perf as _perf
+    wrapped = _perf.wrap(jax.jit(fn), "kernels", key)
+    out = wrapped(*args)
+    jax.block_until_ready(out)
+    return out, _perf.program("kernels", key)

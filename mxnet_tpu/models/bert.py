@@ -9,9 +9,12 @@ matrix) and the next-sentence head.
 TPU-native: the encoder is TransformerLM's scanned-layer stack with
 ``causal=False`` (bidirectional attention), so every sharding the flagship
 model has — batch on 'dp', Megatron head/MLP splits on 'tp', ring-attention
-sequence sharding on 'sp' — applies to BERT pretraining unchanged.  The
-pretraining loss masks out non-masked positions with gather, not dynamic
-shapes, keeping the whole step one static XLA program.
+sequence sharding on 'sp' — applies to BERT pretraining unchanged, as does
+the kernel tier: with MXNET_TPU_KERNELS on, the encoder's attention routes
+through the fused Pallas flash kernel (non-causal path) and the scanned
+stack picks up the runtime.scan_stack remat/unroll tuning — no BERT-side
+code involved.  The pretraining loss masks out non-masked positions with
+gather, not dynamic shapes, keeping the whole step one static XLA program.
 """
 from __future__ import annotations
 

@@ -24,7 +24,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.ring_attention import attention, ring_self_attention_sharded
+from .. import kernels as _kernels
+from .. import runtime as _runtime
+from ..parallel.ring_attention import ring_self_attention_sharded
 
 __all__ = ["TransformerLMConfig", "TransformerLM"]
 
@@ -123,7 +125,10 @@ class TransformerLM:
             return ring_self_attention_sharded(
                 self.mesh, q, k, v, causal=self.cfg.causal,
                 batch_axis=self._dp, head_axis=self._tp, seq_axis=self._sp)
-        return attention(q, k, v, causal=self.cfg.causal)
+        # mx.kernels routes to the fused Pallas flash kernel when the
+        # tier is on and the shape qualifies; otherwise (and by default)
+        # this IS the plain XLA attention lowering
+        return _kernels.attention(q, k, v, causal=self.cfg.causal)
 
     def _layer(self, x, lp):
         cfg = self.cfg
@@ -164,7 +169,9 @@ class TransformerLM:
         def body(carry, lp):
             return self._layer(carry, lp), None
 
-        x, _ = lax.scan(body, x, params["layers"])
+        # runtime.scan_stack applies the knob-selected scan/unroll +
+        # remat policy; at default knobs it is exactly lax.scan(body, ...)
+        x, _ = _runtime.scan_stack(body, x, params["layers"])
         return _norm(x, params["final_norm"])
 
     def apply(self, params, tokens):

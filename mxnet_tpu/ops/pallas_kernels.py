@@ -10,8 +10,21 @@ block lives in VMEM, and the body is VPU elementwise math with on-chip
 reductions — no HBM roundtrips between the fused stages.  On CPU they run
 through the Pallas interpreter (same numerics), so tests validate the
 kernels without a TPU.
+
+Kernel tier (docs/PERF_NOTES.md "Kernel tier"): flash attention is a
+full training kernel — the tiled online-softmax forward saves per-row
+logsumexp residuals and a Pallas backward (recompute-style, two kernels:
+dq over q blocks, dk/dv over kv blocks) rides ``jax.custom_vjp``.  The
+fused optimizer epilogues (``fused_sgd_step``/``fused_adam_step``) fold
+the whole elementwise update chain plus the low-precision cast into ONE
+kernel so bf16 params never round-trip through a separate f32 master
+copy program.  Routing and fallback live in ``mx.kernels``; the raw
+kernels here stay policy-free.
 """
 from __future__ import annotations
+
+import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -19,27 +32,21 @@ import jax.numpy as jnp
 from .registry import register
 
 __all__ = ["pallas_row_softmax", "pallas_scale_bias_relu",
-           "pallas_flash_attention"]
+           "pallas_flash_attention", "flash_attention",
+           "fused_sgd_step", "fused_adam_step"]
+
+_NEG = -1e30
 
 
-def _row_softmax_kernel(x_ref, o_ref):
-    """Numerically-stable softmax over the last axis of one row block.
-    max/sum reductions stay in VMEM — one HBM read, one HBM write."""
-    x = x_ref[:]
-    m = jnp.max(x, axis=-1, keepdims=True)
-    e = jnp.exp(x - m)
-    o_ref[:] = e / jnp.sum(e, axis=-1, keepdims=True)
-
-
-def _scale_bias_relu_kernel(x_ref, scale_ref, bias_ref, o_ref):
-    """Fused y = relu(x * scale + bias) — the classic post-GEMM epilogue."""
-    o_ref[:] = jnp.maximum(x_ref[:] * scale_ref[:] + bias_ref[:], 0.0)
-
-
-def _row_block(n_rows, row_bytes, budget=2 << 20):
+def _row_block(n_rows, row_bytes, budget=None):
     """Largest divisor of n_rows whose block stays under the VMEM budget
     (a block must tile the array exactly).  O(sqrt(n)) divisor walk — this
-    runs on the host per eager call, so no linear scans."""
+    runs on the host per eager call, so no linear scans.  ``budget``
+    defaults to the validated ``kernels.vmem_budget`` knob
+    (MXNET_TPU_KERNELS_VMEM_BUDGET)."""
+    if budget is None:
+        from .. import config as _config
+        budget = _config.get("kernels.vmem_budget")
     cap = max(1, budget // max(row_bytes, 1))
     best = 1
     i = 1
@@ -54,37 +61,108 @@ def _row_block(n_rows, row_bytes, budget=2 << 20):
     return best
 
 
-@register("pallas_softmax", differentiable=False)
+# ------------------------------------------------------------ row softmax
+def _row_softmax_kernel(x_ref, o_ref, m_ref, l_ref):
+    """Numerically-stable softmax over the last axis of one row block.
+    max/sum reductions stay in VMEM — one HBM read, one HBM write for the
+    rows plus two [rows, 1] residual columns (the saved row max/sum the
+    custom-vjp backward reuses)."""
+    x = x_ref[:]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    o_ref[:] = e / s
+    m_ref[:] = m
+    l_ref[:] = s
+
+
+def _row_softmax_bwd_kernel(x_ref, m_ref, l_ref, dy_ref, dx_ref):
+    """softmax VJP from the saved row max/sum: y rebuilds as
+    exp(x - m)/l on chip (no second max/sum pass), then
+    dx = y * (dy - sum(dy * y))."""
+    y = jnp.exp(x_ref[:] - m_ref[:]) / l_ref[:]
+    dy = dy_ref[:]
+    dx_ref[:] = y * (dy - jnp.sum(dy * y, axis=-1, keepdims=True))
+
+
+def _softmax_fwd_call(flat):
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    n, d = flat.shape
+    rows = _row_block(n, d * flat.dtype.itemsize)
+    return pl.pallas_call(
+        _row_softmax_kernel,
+        out_shape=[jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+                   jax.ShapeDtypeStruct((n, 1), flat.dtype),
+                   jax.ShapeDtypeStruct((n, 1), flat.dtype)],
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                   pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((rows, 1), lambda i: (i, 0))],
+        interpret=interpret_mode())(flat)
+
+
+def _softmax_bwd_call(x, m, l, dy):
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    n, d = x.shape
+    rows = _row_block(n, d * x.dtype.itemsize)
+    return pl.pallas_call(
+        _row_softmax_bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((rows, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        interpret=interpret_mode())(x, m, l, dy)
+
+
+@jax.custom_vjp
+def _row_softmax(flat):
+    return _softmax_fwd_call(flat)[0]
+
+
+def _row_softmax_fwd(flat):
+    y, m, l = _softmax_fwd_call(flat)
+    return y, (flat, m, l)
+
+
+def _row_softmax_bwd(res, dy):
+    x, m, l = res
+    return (_softmax_bwd_call(x, m, l, dy),)
+
+
+_row_softmax.defvjp(_row_softmax_fwd, _row_softmax_bwd)
+
+
+@register("pallas_softmax")
 def pallas_row_softmax(data, **_):
     """Row softmax via the Pallas kernel (mx.nd.pallas_softmax).
 
     The grid walks row blocks sized to fit VMEM, so arbitrarily tall
     logits tensors stream through the kernel; one row must fit on chip
-    (true for any real vocab at fp32: 32k cols = 128KB)."""
-    from jax.experimental import pallas as pl
-    from ..rtc import interpret_mode
+    (true for any real vocab at fp32: 32k cols = 128KB).  Differentiable:
+    the forward saves the per-row max and sum and the custom-vjp backward
+    kernel reuses them (no recomputed reductions)."""
     x = jnp.asarray(data)
     flat = x.reshape(-1, x.shape[-1])
-    n, d = flat.shape
-    rows = _row_block(n, d * flat.dtype.itemsize)
-    out = pl.pallas_call(
-        _row_softmax_kernel,
-        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
-        grid=(n // rows,),
-        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
-        interpret=interpret_mode())(flat)
-    return out.reshape(x.shape)
+    return _row_softmax(flat).reshape(x.shape)
 
 
-def _flash_attention_kernel(scale, causal, block_q, q_ref, k_ref, v_ref,
-                            o_ref):
+# ------------------------------------------------------- flash attention
+def _flash_fwd_kernel(scale, causal, block_q, q_ref, k_ref, v_ref,
+                      o_ref, lse_ref):
     """One q block vs the full K/V of its (batch, head) slice.
 
     The score matrix [block_q, S] lives only in VMEM — it is never
     materialized in HBM, which is the whole point of flash attention: HBM
     traffic is O(S*D) instead of O(S^2).  Softmax accumulates in f32 on
-    chip; the MXU does both matmuls.
+    chip; the MXU does both matmuls.  The per-row logsumexp lands in a
+    [block_q] residual strip so the backward can rebuild the
+    probabilities without a second max/sum pass.
     """
     from jax.experimental import pallas as pl
     q = q_ref[0].astype(jnp.float32)                # [bq, D]
@@ -97,36 +175,188 @@ def _flash_attention_kernel(scale, causal, block_q, q_ref, k_ref, v_ref,
         q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= q_pos, s, -1e30)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
     acc = jax.lax.dot_general(e.astype(v.dtype), v,
                               (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.float32)
-    o_ref[0] = (acc / jnp.sum(e, axis=-1, keepdims=True)).astype(
-        o_ref.dtype)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m[:, 0] + jnp.log(l[:, 0])
 
 
-@register("pallas_flash_attention", differentiable=False)
-def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                           **_):
-    """Flash attention via Pallas (mx.nd.pallas_flash_attention).
+def _flash_bwd_dq_kernel(scale, causal, block_q, q_ref, k_ref, v_ref,
+                         do_ref, lse_ref, delta_ref, dq_ref):
+    """dq for one q block: recompute the probabilities from the saved
+    logsumexp (p = exp(s - lse)), then
+    ds = p * (dO @ V^T - delta) * scale and dq = ds @ K — the score and
+    ds matrices stay in VMEM."""
+    from jax.experimental import pallas as pl
+    q = q_ref[0].astype(jnp.float32)                # [bq, D]
+    k = k_ref[0].astype(jnp.float32)                # [S, D]
+    v = v_ref[0].astype(jnp.float32)                # [S, D]
+    do = do_ref[0].astype(jnp.float32)              # [bq, D]
+    lse = lse_ref[0]                                # [bq]
+    delta = delta_ref[0]                            # [bq]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        i = pl.program_id(1)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+    p = jnp.exp(s - lse[:, None])                   # [bq, S]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(scale, causal, block_k, q_ref, k_ref, v_ref,
+                          do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+    """dk/dv for one kv block against the full Q/dO of its (batch, head):
+    the transposed score strip [block_k, Sq] rebuilds from the saved
+    logsumexp, dv = P^T @ dO and dk = dS^T @ Q accumulate in f32 on the
+    MXU."""
+    from jax.experimental import pallas as pl
+    q = q_ref[0].astype(jnp.float32)                # [Sq, D]
+    k = k_ref[0].astype(jnp.float32)                # [bk, D]
+    v = v_ref[0].astype(jnp.float32)                # [bk, D]
+    do = do_ref[0].astype(jnp.float32)              # [Sq, D]
+    lse = lse_ref[0]                                # [Sq]
+    delta = delta_ref[0]                            # [Sq]
+    st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    if causal:
+        j = pl.program_id(1)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, st.shape, 0)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
+        st = jnp.where(k_pos <= q_pos, st, _NEG)
+    pt = jnp.exp(st - lse[None, :])                 # [bk, Sq]
+    dv_ref[0] = jax.lax.dot_general(
+        pt, do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    dst = pt * (dpt - delta[None, :]) * scale
+    dk_ref[0] = jax.lax.dot_general(
+        dst, q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q):
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    B, H, S, D = q.shape
+    Skv = k.shape[2]
+    # largest divisor of S <= block_q, so an awkward block_q degrades to
+    # the best legal tiling instead of cliff-diving to 1-row blocks
+    bq = _row_block(S, 1, budget=min(block_q, S))
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, Skv, D)
+    vf = v.reshape(B * H, Skv, D)
+    kernel = functools.partial(_flash_fwd_kernel, scale, bool(causal), bq)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct(qf.shape, q.dtype),
+                   jax.ShapeDtypeStruct((B * H, S), jnp.float32)],
+        grid=(B * H, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0))],
+        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+        interpret=interpret_mode())(qf, kf, vf)
+    return out.reshape(B, H, S, D), lse
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q):
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    B, H, S, D = q.shape
+    Skv = k.shape[2]
+    bq = _row_block(S, 1, budget=min(block_q, S))
+    bk = _row_block(Skv, 1, budget=min(block_q, Skv))
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, Skv, D)
+    vf = v.reshape(B * H, Skv, D)
+    dof = do.reshape(B * H, S, D)
+    # delta = rowsum(dO * O) — elementwise O(S*D), cheap in plain XLA
+    delta = jnp.sum(dof.astype(jnp.float32) *
+                    o.reshape(B * H, S, D).astype(jnp.float32), axis=-1)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale, bool(causal), bq),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(B * H, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        interpret=interpret_mode())(qf, kf, vf, dof, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale, bool(causal), bk),
+        out_shape=[jax.ShapeDtypeStruct(kf.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vf.shape, v.dtype)],
+        grid=(B * H, Skv // bk),
+        in_specs=[pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+                  pl.BlockSpec((1, S), lambda b, j: (b, 0))],
+        out_specs=[pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                   pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0))],
+        interpret=interpret_mode())(qf, kf, vf, dof, lse, delta)
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, Skv, D),
+            dv.reshape(B, H, Skv, D))
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_vjp(causal, scale, block_q):
+    """custom_vjp wrapper per hashable (causal, scale, block_q) static
+    config — the lru_cache keeps one stable function identity per config
+    so jit caches don't churn."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _flash_forward(q, k, v, causal, scale, block_q)[0]
+
+    def f_fwd(q, k, v):
+        o, lse = _flash_forward(q, k, v, causal, scale, block_q)
+        return o, (q, k, v, o, lse)
+
+    def f_bwd(res, do):
+        q, k, v, o, lse = res
+        return _flash_backward(q, k, v, o, lse, do, causal, scale, block_q)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128):
+    """Fused flash attention, forward AND backward as Pallas kernels.
 
     q/k/v: [B, H, S, D].  The grid walks (batch*heads, q blocks); each
     step holds one q block plus its head's full K/V in VMEM (S*D per
     operand — S=8k at D=128 bf16 is 2MB, comfortably on chip), so the
-    S x S score matrix never touches HBM.  Sequences larger than VMEM
-    shard S over the 'sp' mesh axis first (parallel.ring_attention) and
-    run this kernel per shard.  Forward-only by design — training uses
-    the XLA attention whose backward XLA fuses well; this is the
-    inference escape hatch (reference analog: hand-written fused CUDA
-    attention via RTC, src/common/rtc.cc).
+    S x S score matrix never touches HBM.  The forward additionally saves
+    a per-row logsumexp strip; the ``jax.custom_vjp`` backward recomputes
+    the probabilities from it in two more Pallas kernels (dq over q
+    blocks; dk/dv over kv blocks), keeping backward HBM traffic O(S*D)
+    too.  Sequences larger than VMEM shard S over the 'sp' mesh axis
+    first (parallel.ring_attention) and run this kernel per shard.
+    Routing/fallback policy lives in ``mx.kernels.attention``
+    (reference analog: hand-written fused CUDA attention via RTC,
+    src/common/rtc.cc).
     """
-    import math
-    from jax.experimental import pallas as pl
-    from ..rtc import interpret_mode
-    import functools
-
     q = jnp.asarray(q)
     k = jnp.asarray(k)
     v = jnp.asarray(v)
@@ -139,24 +369,135 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         raise ValueError("k and v shapes differ: %s vs %s"
                          % (k.shape, v.shape))
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
-    # largest divisor of S <= block_q, so an awkward block_q degrades to
-    # the best legal tiling instead of cliff-diving to 1-row blocks
-    bq = _row_block(S, 1, budget=min(block_q, S))
-    qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, Skv, D)
-    vf = v.reshape(B * H, Skv, D)
-    kernel = functools.partial(_flash_attention_kernel, scale, bool(causal),
-                               bq)
-    out = pl.pallas_call(
+    return _flash_vjp(bool(causal), scale, int(block_q))(q, k, v)
+
+
+@register("pallas_flash_attention")
+def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                           **_):
+    """Flash attention via Pallas (mx.nd.pallas_flash_attention) —
+    differentiable; see ``flash_attention`` for the kernel story."""
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           block_q=block_q)
+
+
+# ------------------------------------------- fused optimizer+cast epilogue
+def _sgd_epilogue_kernel(momentum, w_ref, g_ref, mom_ref, lr_ref, wd_ref,
+                         lp_ref, w_out_ref, mom_out_ref):
+    """SGD+momentum update and low-precision cast in one VMEM pass: the
+    f32 master row block is read once, the new master, momentum and cast
+    weight are written — no intermediate HBM arrays between the stages."""
+    w = w_ref[:]
+    g = g_ref[:] + wd_ref[0, 0] * w
+    mom = momentum * mom_ref[:] + lr_ref[0, 0] * g
+    nw = w - mom
+    w_out_ref[:] = nw
+    mom_out_ref[:] = mom
+    lp_ref[:] = nw.astype(lp_ref.dtype)
+
+
+def _sgd_nomom_epilogue_kernel(w_ref, g_ref, lr_ref, wd_ref, lp_ref,
+                               w_out_ref):
+    w = w_ref[:]
+    g = g_ref[:] + wd_ref[0, 0] * w
+    nw = w - lr_ref[0, 0] * g
+    w_out_ref[:] = nw
+    lp_ref[:] = nw.astype(lp_ref.dtype)
+
+
+def _adam_epilogue_kernel(beta1, beta2, eps, w_ref, g_ref, m_ref, v_ref,
+                          lr_t_ref, wd_ref, lp_ref, w_out_ref, m_out_ref,
+                          v_out_ref):
+    """Adam update + cast in one VMEM pass; the bias-corrected lr_t is
+    precomputed outside (it depends on the traced step count, not the
+    row block) and rides in as a (1,1) scalar block."""
+    w = w_ref[:]
+    g = g_ref[:] + wd_ref[0, 0] * w
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    nw = w - lr_t_ref[0, 0] * m / (jnp.sqrt(v) + eps)
+    w_out_ref[:] = nw
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+    lp_ref[:] = nw.astype(lp_ref.dtype)
+
+
+def _flat2d(a):
+    if a.ndim >= 2:
+        return a.reshape(-1, a.shape[-1])
+    if a.ndim == 1:
+        return a.reshape(1, -1)
+    return a.reshape(1, 1)
+
+
+def _epilogue_call(kernel, arrays, scalars, out_dtypes):
+    """Launch an elementwise epilogue kernel over same-shape operands:
+    arrays flatten to 2-D and stream through shared row blocks; scalars
+    ride as (1,1) blocks pinned to every grid step."""
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    shape = arrays[0].shape
+    flats = [_flat2d(a) for a in arrays]
+    n, d = flats[0].shape
+    itemsize = max(f.dtype.itemsize for f in flats)
+    rows = _row_block(n, d * itemsize * (len(arrays) + len(out_dtypes)))
+    scal = [jnp.asarray(s, jnp.float32).reshape(1, 1) for s in scalars]
+    outs = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(B * H, S // bq),
-        in_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0)),
-                  pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0))],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        interpret=interpret_mode())(qf, kf, vf)
-    return out.reshape(B, H, S, D)
+        out_shape=[jax.ShapeDtypeStruct((n, d), dt) for dt in out_dtypes],
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0))
+                  for _ in flats] +
+                 [pl.BlockSpec((1, 1), lambda i: (0, 0)) for _ in scal],
+        out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0))
+                   for _ in out_dtypes],
+        interpret=interpret_mode())(*(flats + scal))
+    return [o.reshape(shape) for o in outs]
+
+
+def fused_sgd_step(weight, grad, state, lr, wd, momentum, out_dtype=None):
+    """Single-kernel SGD(+momentum) update with cast epilogue.
+
+    ``weight`` is the f32 master; returns
+    ``(weight_cast[out_dtype], new_master, new_state)`` — identical math
+    and op order to ``SGD.step`` followed by ``astype``, so the result is
+    bitwise-equal to the master-copy round trip it replaces."""
+    weight = jnp.asarray(weight)
+    grad = jnp.asarray(grad)
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None \
+        else weight.dtype
+    if momentum == 0.0:
+        lp, nw = _epilogue_call(
+            _sgd_nomom_epilogue_kernel, [weight, grad], [lr, wd],
+            [out_dtype, weight.dtype])
+        return lp, nw, None
+    lp, nw, mom = _epilogue_call(
+        functools.partial(_sgd_epilogue_kernel, momentum),
+        [weight, grad, state], [lr, wd],
+        [out_dtype, weight.dtype, state.dtype])
+    return lp, nw, mom
+
+
+def fused_adam_step(weight, grad, m, v, lr_t, wd, beta1, beta2, eps,
+                    out_dtype=None):
+    """Single-kernel Adam update with cast epilogue (see
+    ``fused_sgd_step``); ``lr_t`` is the bias-corrected learning rate the
+    caller computes from the traced step count."""
+    weight = jnp.asarray(weight)
+    grad = jnp.asarray(grad)
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None \
+        else weight.dtype
+    lp, nw, nm, nv = _epilogue_call(
+        functools.partial(_adam_epilogue_kernel, beta1, beta2, eps),
+        [weight, grad, m, v], [lr_t, wd],
+        [out_dtype, weight.dtype, m.dtype, v.dtype])
+    return lp, nw, (nm, nv)
+
+
+# ------------------------------------------------------- fused elementwise
+def _scale_bias_relu_kernel(x_ref, scale_ref, bias_ref, o_ref):
+    """Fused y = relu(x * scale + bias) — the classic post-GEMM epilogue."""
+    o_ref[:] = jnp.maximum(x_ref[:] * scale_ref[:] + bias_ref[:], 0.0)
 
 
 @register("pallas_scale_bias_relu", differentiable=False)

@@ -311,6 +311,10 @@ class SPMDTrainer:
             return loss, (new_aux, out)
 
         guard = self._guard_mode
+        from .. import kernels as _kernels
+        fused_opt = _kernels.fused_step_enabled(optimizer)
+        if fused_opt:
+            _kernels.note_fused_step()
 
         def step(train_params, aux_params, opt_state, data, label, key, t,
                  lrs, wds, lr_scale, streak=None):
@@ -325,8 +329,19 @@ class SPMDTrainer:
             # program — keep a trace key scope open for the update loop.
             with _random.trace_key_scope(jax.random.fold_in(key, 1)):
                 for i, n in enumerate(trainable):
-                    w, s = optimizer.step(train_params[n],
-                                          _preprocess(optimizer, grads[n]),
+                    g = _preprocess(optimizer, grads[n])
+                    if fused_opt and \
+                            train_params[n].dtype == jnp.float32:
+                        # fused Pallas epilogue: update + cast in one
+                        # kernel (bitwise-equal to the step/astype pair)
+                        w, _m, s = optimizer.step_fused(
+                            train_params[n], g, opt_state[n],
+                            lrs[i] * lr_scale, wds[i], t,
+                            out_dtype=train_params[n].dtype)
+                        new_params[n] = w
+                        new_state[n] = s
+                        continue
+                    w, s = optimizer.step(train_params[n], g,
                                           opt_state[n], lrs[i] * lr_scale,
                                           wds[i], t)
                     new_params[n] = w.astype(train_params[n].dtype)
@@ -434,6 +449,10 @@ class SPMDTrainer:
             return loss, (new_aux, out, ctx.records)
 
         guard = self._guard_mode
+        from .. import kernels as _kernels
+        fused_opt = _kernels.fused_step_enabled(optimizer)
+        if fused_opt:
+            _kernels.note_fused_step()
 
         def step(train_params, aux_params, opt_state, emb_tables, data,
                  label, key, t, lrs, wds, lr_scale, streak=None):
@@ -471,8 +490,17 @@ class SPMDTrainer:
                         new_params[n] = w.astype(emb_tables[n].dtype)
                         new_state[n] = s
                         continue
-                    w, s = optimizer.step(train_params[n],
-                                          _preprocess(optimizer, grads[n]),
+                    g = _preprocess(optimizer, grads[n])
+                    if fused_opt and \
+                            train_params[n].dtype == jnp.float32:
+                        w, _m, s = optimizer.step_fused(
+                            train_params[n], g, opt_state[n],
+                            lrs[i] * lr_scale, wds[i], t,
+                            out_dtype=train_params[n].dtype)
+                        new_params[n] = w
+                        new_state[n] = s
+                        continue
+                    w, s = optimizer.step(train_params[n], g,
                                           opt_state[n], lrs[i] * lr_scale,
                                           wds[i], t)
                     new_params[n] = w.astype(train_params[n].dtype)
@@ -554,7 +582,10 @@ class SPMDTrainer:
         if self.params is None:
             self._materialize(data)
         guard = _resilience.nanguard_mode()
-        if self._jitted and guard != self._guard_mode:
+        from .. import kernels as _kernels
+        kmode = _kernels.enabled()
+        if self._jitted and (guard != self._guard_mode or
+                             kmode != getattr(self, "_kernel_mode", kmode)):
             self._jitted.clear()  # knob flip: rebuild with/without the guard
         # the program cache is keyed by pad count: the pad-masked loss uses
         # a STATIC slice so its reduction is structurally identical to the
@@ -563,11 +594,17 @@ class SPMDTrainer:
         jitted = self._jitted.get(pad)
         if jitted is None:
             self._guard_mode = guard
+            self._kernel_mode = kmode
             from .. import perf as _perf
+            # kernels=on earns its own program key; the OFF key is
+            # unchanged from earlier rounds so perf artifacts stay
+            # comparable across releases
+            pkey = "pad=%d/guard=%s" % (pad, guard)
+            if kmode:
+                pkey += "/kernels=on"
             with _tracing.span("spmd.compile", cat="spmd"):
                 jitted = self._jitted[pad] = _perf.wrap(
-                    self._build(pad), "spmd",
-                    "pad=%d/guard=%s" % (pad, guard), source="spmd")
+                    self._build(pad), "spmd", pkey, source="spmd")
             from .. import profiler as _profiler
             _profiler.counter_increment("fused_compiles")
         # the batch shard_put is the host->mesh boundary; the gradient

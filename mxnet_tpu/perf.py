@@ -64,7 +64,7 @@ __all__ = [
     "OP_CLASSES", "classify_op", "hlo_op_classes", "device_kind",
     "peak_flops", "peak_bandwidth", "roofline", "register_compiled",
     "programs", "program", "reset", "export", "wrap", "PerfProgram",
-    "configure_profile",
+    "configure_profile", "cost_analysis",
 ]
 
 # ----------------------------------------------------------- peak tables
@@ -324,6 +324,29 @@ def register_compiled(family, key, compiled, phases_ms=None, dtype=None):
     with _REG_LOCK:
         _PROGRAMS[(rec["family"], rec["key"])] = rec
     return rec
+
+
+def cost_analysis(fn, *args):
+    """Compiler cost analysis for ``fn(*args)`` without running it:
+    ``{"flops", "bytes_accessed", "transcendentals"}`` floats, or None
+    when the backend exposes no analysis.  ``fn`` may be plain or
+    already jitted — either way this only lowers and compiles (AOT);
+    tools/opperf.py uses it for per-op achieved-GFLOPs columns."""
+    import jax
+    try:
+        if not hasattr(fn, "lower"):
+            fn = jax.jit(fn)
+        c = fn.lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0] if c else {}
+        c = dict(c or {})
+    except Exception:  # noqa: BLE001 — backend without cost analysis
+        return None
+    if not c:
+        return None
+    return {"flops": float(c.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(c.get("bytes accessed", 0.0) or 0.0),
+            "transcendentals": float(c.get("transcendentals", 0.0) or 0.0)}
 
 
 def _public(rec):

@@ -1,16 +1,24 @@
-"""``mx.runtime`` — runtime feature introspection.
+"""``mx.runtime`` — runtime feature introspection + program tuning.
 
 Reference: python/mxnet/runtime.py `Features`/`feature_list` over the libinfo
 build flags (include/mxnet/libinfo.h:141-193 — CUDA, CUDNN, MKLDNN,
 DIST_KVSTORE...).  TPU-native: features reflect what this build can actually
 do (platform backends, pallas availability, distributed init), discovered at
 query time instead of baked at compile time.
+
+Program tuning (``scan_stack``): the knob-driven scan/unroll + remat
+policy applied to repeated-layer stacks — the TPU analog of the
+reference graph optimizer's memory-vs-recompute planning.  Scanning the
+layer stack keeps trace and compile time O(1) in depth; a
+``jax.checkpoint`` policy trades activation memory for recompute in the
+backward pass.
 """
 from __future__ import annotations
 
 from collections import namedtuple
 
-__all__ = ["Feature", "Features", "feature_list", "is_enabled"]
+__all__ = ["Feature", "Features", "feature_list", "is_enabled",
+           "scan_stack", "stack_tuning", "checkpoint_policy"]
 
 Feature = namedtuple("Feature", ["name", "enabled"])
 
@@ -81,3 +89,60 @@ def feature_list():
 
 def is_enabled(feature_name):
     return Features().is_enabled(feature_name)
+
+
+# --------------------------------------------------------- program tuning
+def stack_tuning():
+    """The active (mode, remat) pair from the validated knobs
+    ``runtime.stack_mode`` (scan|unroll) and ``runtime.remat``
+    (''|dots|full)."""
+    from . import config as _config
+    return _config.get("runtime.stack_mode"), _config.get("runtime.remat")
+
+
+def checkpoint_policy(name):
+    """Resolve a remat policy name to a ``jax.checkpoint`` policy:
+    '' -> None (no remat), 'dots' -> save matmul results and recompute
+    the elementwise rest (the MFU-friendly default — recomputing
+    elementwise ops is cheap, recomputing matmuls is not), 'full' ->
+    save only the layer inputs (maximum memory saving)."""
+    import jax
+    if name == "dots":
+        pols = jax.checkpoint_policies
+        return (getattr(pols, "dots_saveable", None)
+                or pols.checkpoint_dots)
+    if name == "full":
+        return "full"
+    return None
+
+
+def scan_stack(body, carry, xs):
+    """Run ``body(carry, x)`` over the leading axis of ``xs`` with the
+    knob-selected stacking strategy.
+
+    ``runtime.stack_mode='scan'`` (default) lowers one ``lax.scan`` —
+    the program traces and compiles the layer ONCE regardless of depth,
+    which is where the trace/compile-time win over an unrolled stack
+    comes from.  ``'unroll'`` inlines every layer (larger programs,
+    but XLA can specialize per layer).  ``runtime.remat`` wraps the body
+    in ``jax.checkpoint`` with the matching policy; '' applies no wrapper
+    at all so default-knob programs stay byte-identical to the
+    pre-tuning lowering.
+    """
+    import jax
+    from jax import lax
+    mode, remat = stack_tuning()
+    if remat:
+        policy = checkpoint_policy(remat)
+        if policy == "full":
+            body = jax.checkpoint(body)
+        else:
+            body = jax.checkpoint(body, policy=policy)
+    if mode == "unroll":
+        leaves = jax.tree_util.tree_leaves(xs)
+        n = leaves[0].shape[0]
+        for i in range(n):
+            x = jax.tree_util.tree_map(lambda a: a[i], xs)
+            carry, _ = body(carry, x)
+        return carry, None
+    return lax.scan(body, carry, xs)

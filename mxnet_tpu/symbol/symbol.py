@@ -1080,6 +1080,13 @@ class Executor:
         # invalidation contract as _fwd_cache/_bwd_cache)
         self._fused_cache = {k: v for k, v in self._fused_cache.items()
                              if k[-1] == key_sig[-1]}
+        # fused Pallas optimizer epilogue (mx.kernels): trace-time
+        # decision; a kernels-knob flip bumps the config epoch, so the
+        # key above already forces the retrace
+        from .. import kernels as _kernels
+        fused_opt = _kernels.fused_step_enabled(optimizer)
+        if fused_opt:
+            _kernels.note_fused_step()
 
         def run(wrt_vals, opt_state, rest_env, feeds, key, t, lrs, wds,
                 streak=None):
@@ -1105,6 +1112,13 @@ class Executor:
                     g = grads[n] * rescale
                     if clip is not None:
                         g = jnp.clip(g, -clip, clip)
+                    if fused_opt and wrt_vals[n].dtype == jnp.float32:
+                        w, _m, s = optimizer.step_fused(
+                            wrt_vals[n], g, opt_state[n], lrs[i], wds[i],
+                            t, out_dtype=wrt_vals[n].dtype)
+                        new_w[n] = w
+                        new_s[n] = s
+                        continue
                     w, s = optimizer.step(wrt_vals[n], g, opt_state[n],
                                           lrs[i], wds[i], t)
                     new_w[n] = w.astype(wrt_vals[n].dtype)
