@@ -373,6 +373,11 @@ def run_bench(runs_out):
         runs_out.append({"mode": "quantized_serving",
                          "error": "%s: %s" % (type(e).__name__, e)})
     try:
+        generation_config(runs_out, 24 if on_tpu else 12)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "generation",
+                         "error": "%s: %s" % (type(e).__name__, e)})
+    try:
         transformer_kernels_config(runs_out, on_tpu)
     except Exception as e:  # noqa: BLE001
         runs_out.append({"mode": "transformer_kernels",
@@ -826,6 +831,130 @@ def quantized_serving_config(runs_out, requests):
                      "int8_over_fp32": round(int8_rps / fp32_rps, 2)})
 
 
+def generation_config(runs_out, requests):
+    """Secondary: token-level continuous batching vs static batch-1
+    generation, tokens/s and time-to-first-token under mixed lengths.
+
+    One v4 generation artifact (tiny TransformerLM, paged KV cache)
+    serves the same mixed-prompt-length request stream two ways: a
+    static batch-1 loop calling ``GenerationPredictor.generate`` per
+    request (every request decodes alone and every later request waits
+    for the WHOLE earlier one), and a burst of ``submit_generate`` into
+    a :class:`serving.Server` whose per-iteration scheduler packs up to
+    ``serving.decode_slots`` sequences into each single-token decode
+    dispatch, admitting queued prefills and exiting finished sequences
+    mid-flight.  tokens/s for both paths land under runs[] with mode
+    "generation" plus the continuous path's server-side TTFT p50/p99
+    (``serving.ttft_ms``); the static path's TTFT p99 is the queue-
+    serialization lower bound (elapsed time before a request's generate
+    call even STARTS — its own prefill would only add to it).  Surfaces
+    as the generation_throughput secondary (docs/SERVING.md).  PR
+    acceptance pins continuous > static on tokens/s."""
+    import math
+    import tempfile
+    import numpy as np
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import deploy, serving, telemetry
+    from mxnet_tpu.models.transformer import (TransformerLM,
+                                              TransformerLMConfig)
+
+    VOCAB, PAGE, CTX, SLOTS = 89, 8, 32, 4
+    cfg = TransformerLMConfig(
+        vocab_size=VOCAB, num_layers=2, d_model=32, num_heads=2,
+        d_ff=64, max_len=CTX, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    # host-side numpy param init (model.init would spend ~1s compiling
+    # jax.random); amplified pos_embed keeps greedy streams position-
+    # dependent so decode steps do real work
+    prng = np.random.default_rng(0)
+    L, D, F = 2, cfg.d_model, cfg.d_ff
+    H, Dh = cfg.num_heads, cfg.head_dim
+
+    def mk(*shape):
+        return jnp.asarray(
+            prng.normal(0.0, 0.02, size=shape).astype(np.float32))
+
+    params = {
+        "embed": mk(VOCAB, D),
+        "pos_embed": mk(CTX, D) * 25.0,
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "layers": {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "wqkv": mk(L, D, 3, H, Dh),
+            "wo": mk(L, H, Dh, D),
+            "ln2": jnp.ones((L, D), jnp.float32),
+            "w1": mk(L, D, F),
+            "w2": mk(L, F, D),
+        },
+    }
+    prefix = os.path.join(tempfile.mkdtemp(prefix="mxtpu_bench_gen_"),
+                          "lm")
+    deploy.export_generation(model, params, prefix, page_size=PAGE,
+                             max_context=CTX, prompt_buckets=(8, 16))
+
+    # mixed lengths across both prefill buckets, budgets that finish at
+    # different decode iterations (mid-flight exits + joins)
+    mix = [(3, 9), (7, 6), (12, 12), (5, 8), (9, 10), (14, 7)]
+    traffic = [mix[i % len(mix)] for i in range(requests)]
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, VOCAB, size=p).astype(np.int32)
+               for p, _ in traffic]
+    total_new = sum(n for _, n in traffic)
+
+    # static batch-1: each request decodes alone, strictly in turn.
+    # One full untimed pass first — the offline predictor jit-caches per
+    # (prompt bucket, pool size, table width), so a partial warm would
+    # bill compiles to the timed pass.
+    pred = deploy.load_generator(prefix)
+    for pr, (_, n) in zip(prompts, traffic):
+        pred.generate(pr, n)
+    starts_ms = []
+    t0 = time.perf_counter()
+    for pr, (_, n) in zip(prompts, traffic):
+        starts_ms.append((time.perf_counter() - t0) * 1000.0)
+        pred.generate(pr, n)
+    static_wall = time.perf_counter() - t0
+    static_tps = total_new / static_wall
+    static_ttft_p99 = float(np.percentile(np.asarray(starts_ms), 99))
+
+    # continuous: burst everything, the engine packs the decode batch
+    mx.config.set("serving.kv_page_size", PAGE)
+    mx.config.set("serving.kv_pages",
+                  2 * SLOTS * math.ceil(CTX / PAGE))  # pages never bind
+    mx.config.set("serving.decode_slots", SLOTS)
+    srv = serving.Server()
+    srv.register("lm", prefix, generate=True)
+    srv.start()
+    try:
+        srv.generate("lm", prompts[0], 2)       # warm the dispatch path
+        telemetry.timer("serving.ttft_ms").reset()
+        t0 = time.perf_counter()
+        futs = [srv.submit_generate("lm", pr, n)
+                for pr, (_, n) in zip(prompts, traffic)]
+        for f in futs:
+            f.result(timeout=300)
+        cont_wall = time.perf_counter() - t0
+        ttft = telemetry.timer("serving.ttft_ms").stats()
+    finally:
+        srv.stop()
+    cont_tps = total_new / cont_wall
+
+    runs_out.append({"mode": "generation", "path": "static_batch1",
+                     "requests": requests, "new_tokens": total_new,
+                     "tokens_s": round(static_tps, 1),
+                     "ttft_p99_ms": round(static_ttft_p99, 1)})
+    runs_out.append({"mode": "generation", "path": "continuous",
+                     "requests": requests, "new_tokens": total_new,
+                     "decode_slots": SLOTS,
+                     "tokens_s": round(cont_tps, 1),
+                     "ttft_p50_ms": round(ttft["p50"], 1),
+                     "ttft_p99_ms": round(ttft["p99"], 1)})
+    runs_out.append({"mode": "generation", "path": "speedup",
+                     "continuous_over_static":
+                         round(cont_tps / static_tps, 2)})
+
+
 def transformer_kernels_config(runs_out, on_tpu):
     """Secondary: the mx.kernels tier on the transformer hot path.
 
@@ -1081,6 +1210,21 @@ def _summarize(runs):
             "int8_over_fp32":
                 q_runs.get("speedup", {}).get("int8_over_fp32"),
             "measured_error": q_runs["int8"].get("measured_error"),
+        }
+    g_runs = {r.get("path"): r for r in runs
+              if r.get("mode") == "generation"}
+    if "continuous" in g_runs and "static_batch1" in g_runs:
+        secondary["generation_throughput"] = {
+            "continuous_tokens_s": g_runs["continuous"]["tokens_s"],
+            "static_batch1_tokens_s": g_runs["static_batch1"]["tokens_s"],
+            "unit": "tokens/s",
+            "continuous_over_static":
+                g_runs.get("speedup", {}).get("continuous_over_static"),
+            "ttft_p50_ms": g_runs["continuous"].get("ttft_p50_ms"),
+            "ttft_p99_ms": g_runs["continuous"].get("ttft_p99_ms"),
+            "static_ttft_p99_ms":
+                g_runs["static_batch1"].get("ttft_p99_ms"),
+            "decode_slots": g_runs["continuous"].get("decode_slots"),
         }
     k_runs = {r.get("path"): r for r in runs
               if r.get("mode") == "transformer_kernels"}

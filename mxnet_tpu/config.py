@@ -515,6 +515,47 @@ register_knob(
     float, 1000.0,
     "how long an OPEN mx.serving circuit breaker rejects before "
     "transitioning to half-open and letting one probe batch through.")
+register_knob(
+    "serving.kv_page_size", "MXNET_TPU_SERVING_KV_PAGE_SIZE", int, 16,
+    "tokens per KV-cache page for mx.serving generation (docs/SERVING.md "
+    "\"Generation\"): position t of a sequence lives at slot t %% "
+    "page_size of page-table entry t // page_size. Baked into v4 "
+    "deploy.export_generation programs at export time; at serve time the "
+    "artifact's own page size wins. Smaller pages waste less pool memory "
+    "per sequence tail but widen page tables (more decode-program "
+    "shapes).")
+register_knob(
+    "serving.kv_pages", "MXNET_TPU_SERVING_KV_PAGES", int, 256,
+    "device-resident KV page-pool capacity per generation model: the "
+    "GenerationEngine allocates this many pages (each "
+    "kv_page_size tokens x num_layers x heads) at register time and "
+    "recycles them as sequences finish. Admission WAITS when the pool "
+    "cannot cover a request's prompt + max_new_tokens "
+    "(serving.kv_pool_exhausted counts the stalls) — size it for the "
+    "target concurrency x context length. Pool size is a runtime "
+    "dimension (jax.export symbolic), so changing it never recompiles.")
+register_knob(
+    "serving.decode_slots", "MXNET_TPU_SERVING_DECODE_SLOTS", int, 8,
+    "decode-batch width for mx.serving generation: how many sequences "
+    "one per-iteration decode step advances together. Finished sequences "
+    "free their slot mid-flight and queued prefills join without "
+    "recompiling (batch is a symbolic dimension of the exported decode "
+    "program). Raise for throughput, lower for per-token latency.")
+
+
+def _positive_int_knob(name):
+    def apply(value):
+        if int(value) <= 0:
+            # reject at set() time and revert (the nanguard pattern)
+            _OVERRIDES.pop(name, None)
+            raise ValueError("%s must be a positive integer, got %r"
+                             % (name, value))
+    return apply
+
+
+_ON_SET["serving.kv_page_size"] = _positive_int_knob("serving.kv_page_size")
+_ON_SET["serving.kv_pages"] = _positive_int_knob("serving.kv_pages")
+_ON_SET["serving.decode_slots"] = _positive_int_knob("serving.decode_slots")
 
 # Pallas kernel tier (docs/PERF_NOTES.md "Kernel tier")
 register_knob(

@@ -36,25 +36,46 @@ Format history (``meta["format_version"]``):
       error — load it with ``load_model(prefix, quantized=True)`` /
       ``serving.Server.register(..., quantized=True)`` so a caller can
       never serve int8 numerics believing they are fp32.
+  v4  GENERATION artifacts (``export_generation``): instead of one
+      one-shot program the artifact carries TWO program families for
+      autoregressive decoding — a length-bucketed PREFILL
+      (``{prefix}-prefill-s{S}.stablehlo`` per prompt bucket) that seeds
+      a paged KV cache from whole prompts, and a single-token DECODE
+      step (``{prefix}-decode-w{W}.stablehlo`` per page-table width)
+      with signature ``(params, kv_pages, page_table, positions,
+      token_ids)``.  The page-pool size and the batch dim stay SYMBOLIC
+      so the server chooses pool capacity and decode-slot count at load
+      time; meta carries ``generate: true`` + the ``kv`` page spec.
+      v1–v3 artifacts keep loading unchanged; a v4 artifact REFUSES the
+      one-shot load path (``load_model``) — load it with
+      ``load_generator(prefix)`` / ``serving.Server.register(...,
+      generate=True)`` — and ``load_generator`` refuses non-v4 artifacts
+      symmetrically.
 """
 from __future__ import annotations
 
 import json
+import math as _math
 import os
 
 import numpy as _np
 
 __all__ = ["export_model", "load_model", "StableHLOPredictor",
-           "FORMAT_VERSION"]
+           "export_generation", "load_generator", "GenerationPredictor",
+           "FORMAT_VERSION", "GENERATE_FORMAT_VERSION"]
 
 FORMAT_VERSION = 2
 
 #: format version stamped by ``mx.quantization.export_quantized``
 QUANTIZED_FORMAT_VERSION = 3
 
+#: format version stamped by ``export_generation`` (prefill + decode-step
+#: program pair over a paged KV cache)
+GENERATE_FORMAT_VERSION = 4
+
 #: newest format this build can load; future versions error clearly
 #: instead of misinterpreting fields
-MAX_SUPPORTED_FORMAT = 3
+MAX_SUPPORTED_FORMAT = 4
 
 
 def _shape_signature(aval):
@@ -166,8 +187,9 @@ class StableHLOPredictor:
         import jax
         from jax import export as jexport
         from . import io as _io
-        with open(prefix + "-model.stablehlo", "rb") as f:
-            self._exported = jexport.deserialize(f.read())
+        # meta first: the version/flavor gates must fire with a CLEAR
+        # error before any program file is touched (a v4 generation
+        # artifact has no -model.stablehlo at all)
         with open(prefix + "-meta.json") as f:
             self.meta = json.load(f)
         self.format_version = int(self.meta.get("format_version", 1))
@@ -176,6 +198,16 @@ class StableHLOPredictor:
                 "artifact %r is deploy format v%d, newer than this "
                 "build's v%d — upgrade before loading"
                 % (prefix, self.format_version, MAX_SUPPORTED_FORMAT))
+        if self.meta.get("generate", False):
+            raise ValueError(
+                "artifact %r is a GENERATION (format v%d) export: it "
+                "carries prefill + decode-step programs over a paged KV "
+                "cache, not a one-shot predict program. Load it with "
+                "deploy.load_generator(prefix) or "
+                "serving.Server.register(..., generate=True)."
+                % (prefix, self.format_version))
+        with open(prefix + "-model.stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
         self.quantized = bool(self.meta.get("quantized", False))
         if self.quantized and not quantized:
             raise ValueError(
@@ -264,3 +296,338 @@ def load_model(prefix, quantized=False):
     v3 quantized artifacts (and rejected for fp32 ones) — the flag is the
     caller's acknowledgement that outputs carry int8 numerics."""
     return StableHLOPredictor(prefix, quantized=quantized)
+
+
+# --------------------------------------------------------- generation (v4)
+
+def _flatten_params(tree, prefix=""):
+    """Nested param dict -> sorted [(\"a/b/c\", leaf)] — the canonical
+    order for the v4 .npz and meta param_names."""
+    out = []
+    for k in sorted(tree):
+        v = tree[k]
+        key = prefix + str(k)
+        if isinstance(v, dict):
+            out.extend(_flatten_params(v, key + "/"))
+        else:
+            out.append((key, v))
+    return out
+
+
+def _unflatten_params(flat):
+    tree = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def _pow2_family(cap):
+    """Powers of two up to (and always including) ``cap``."""
+    sizes, b = [], 1
+    while b < cap:
+        sizes.append(b)
+        b *= 2
+    sizes.append(int(cap))
+    return tuple(sizes)
+
+
+def export_generation(model, params, prefix, page_size=None,
+                      max_context=None, prompt_buckets=None,
+                      include_params=True):
+    """Serialize a generation-capable model (``models.TransformerLM``) to
+    a v4 artifact: one PREFILL program per prompt-length bucket and one
+    single-token DECODE-step program per page-table width, both over a
+    block-paged KV cache whose pool size — and the batch dim — stay
+    SYMBOLIC (jax.export shape polymorphism), so the serving side picks
+    pool capacity and decode-slot count without re-exporting.
+
+    ``page_size`` defaults to the ``serving.kv_page_size`` knob and is
+    BAKED into the programs (page/slot arithmetic); ``max_context``
+    (default ``model.cfg.max_len``) bounds prompt + generated tokens and
+    sizes the width family; ``prompt_buckets`` defaults to the pow2
+    family over ``max_context`` with sub-8 buckets dropped.  Returns the
+    list of written paths."""
+    import jax
+    from jax import export as jexport
+    import jax.numpy as jnp
+    from . import config as _config
+
+    cfg = model.cfg
+    psz = int(page_size if page_size is not None
+              else _config.get("serving.kv_page_size"))
+    if psz < 1:
+        raise ValueError("page_size must be >= 1, got %d" % psz)
+    max_context = int(max_context if max_context is not None
+                      else cfg.max_len)
+    if max_context > cfg.max_len:
+        raise ValueError(
+            "max_context %d exceeds the model's positional table (%d)"
+            % (max_context, cfg.max_len))
+    if prompt_buckets is None:
+        fam = _pow2_family(max_context)
+        prompt_buckets = tuple(s for s in fam if s >= min(8, max_context))
+    prompt_buckets = tuple(sorted(int(s) for s in prompt_buckets))
+    if not prompt_buckets or prompt_buckets[-1] > max_context:
+        raise ValueError(
+            "prompt_buckets %r must be non-empty and fit max_context %d"
+            % (prompt_buckets, max_context))
+    widths = _pow2_family(_math.ceil(max_context / psz))
+
+    flat = _flatten_params(params)
+    names = [n for n, _ in flat]
+    values = [jnp.asarray(v) for _, v in flat]
+    param_tree = _unflatten_params(dict(zip(names, values)))
+    pspec = jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), param_tree)
+    spec = model.kv_spec()
+    L, H, Dh = spec["num_layers"], spec["num_heads"], spec["head_dim"]
+    kv_dtype = jnp.dtype(spec["dtype"])
+
+    paths = []
+
+    def _export_one(fn, arg_specs, path):
+        exp = jexport.export(jax.jit(fn))(*arg_specs)
+        with open(path, "wb") as f:
+            f.write(exp.serialize())
+        paths.append(path)
+
+    def _dims():
+        scope = jexport.SymbolicScope()
+        (b,) = jexport.symbolic_shape("b", scope=scope)
+        (p,) = jexport.symbolic_shape("p", scope=scope)
+        return b, p
+
+    def _kv_specs(p):
+        shape = (L, p, psz, H, Dh)
+        return (jax.ShapeDtypeStruct(shape, kv_dtype),
+                jax.ShapeDtypeStruct(shape, kv_dtype))
+
+    i32 = jnp.int32
+    for s_bucket in prompt_buckets:
+        w_s = _math.ceil(s_bucket / psz)
+
+        def prefill_fn(ps, kk, vv, tokens, lengths, table):
+            kv, nxt = model.prefill(ps, {"k": kk, "v": vv}, tokens,
+                                    lengths, table, psz)
+            return kv["k"], kv["v"], nxt
+
+        b, p = _dims()
+        kks, vvs = _kv_specs(p)
+        _export_one(
+            prefill_fn,
+            (pspec, kks, vvs,
+             jax.ShapeDtypeStruct((b, s_bucket), i32),
+             jax.ShapeDtypeStruct((b,), i32),
+             jax.ShapeDtypeStruct((b, w_s), i32)),
+            "%s-prefill-s%d.stablehlo" % (prefix, s_bucket))
+
+    for width in widths:
+        def decode_fn(ps, kk, vv, token_ids, positions, table):
+            kv, nxt = model.decode_step(ps, {"k": kk, "v": vv}, token_ids,
+                                        positions, table, psz)
+            return kv["k"], kv["v"], nxt
+
+        b, p = _dims()
+        kks, vvs = _kv_specs(p)
+        _export_one(
+            decode_fn,
+            (pspec, kks, vvs,
+             jax.ShapeDtypeStruct((b,), i32),
+             jax.ShapeDtypeStruct((b,), i32),
+             jax.ShapeDtypeStruct((b, width), i32)),
+            "%s-decode-w%d.stablehlo" % (prefix, width))
+
+    meta = {
+        "param_names": names,
+        "input_dtype": "int32",
+        "format_version": GENERATE_FORMAT_VERSION,
+        "generate": True,
+        "vocab_size": int(cfg.vocab_size),
+        "max_context": max_context,
+        "prompt_buckets": list(prompt_buckets),
+        "decode_widths": list(widths),
+        "kv": dict(spec, page_size=psz),
+    }
+    meta_path = prefix + "-meta.json"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    paths.append(meta_path)
+    if include_params:
+        params_path = prefix + "-params.npz"
+        _np.savez(params_path,
+                  **{n: _np.asarray(v) for n, v in zip(names, values)})
+        paths.append(params_path)
+    return paths
+
+
+class GenerationPredictor:
+    """Reloaded v4 generation artifact: the prefill program family (one
+    per prompt bucket), the decode-step family (one per page-table
+    width), and device-resident params — the stateful-RNN
+    ``c_predict_api`` analog for autoregressive serving.
+
+    ``mx.serving`` drives the programs through its per-iteration
+    scheduler; :meth:`generate` is the OFFLINE single-sequence
+    convenience loop (and the shape the parity tests drive)."""
+
+    def __init__(self, prefix):
+        import jax
+        from jax import export as jexport
+        from . import io as _io
+        with open(prefix + "-meta.json") as f:
+            self.meta = json.load(f)
+        self.format_version = int(self.meta.get("format_version", 1))
+        if self.format_version > MAX_SUPPORTED_FORMAT:
+            raise ValueError(
+                "artifact %r is deploy format v%d, newer than this "
+                "build's v%d — upgrade before loading"
+                % (prefix, self.format_version, MAX_SUPPORTED_FORMAT))
+        if not self.meta.get("generate", False):
+            raise ValueError(
+                "artifact %r is a one-shot predict export (format v%d, "
+                "no generation programs); load it with "
+                "deploy.load_model(prefix) — load_generator only accepts "
+                "v4 artifacts written by deploy.export_generation"
+                % (prefix, self.format_version))
+        self.page_size = int(self.meta["kv"]["page_size"])
+        self.max_context = int(self.meta["max_context"])
+        self.prompt_buckets = tuple(self.meta["prompt_buckets"])
+        self.decode_widths = tuple(self.meta["decode_widths"])
+        self.kv_dtype = _np.dtype(self.meta["kv"]["dtype"])
+        self._prefill_exp = {}
+        self._decode_exp = {}
+        for s_bucket in self.prompt_buckets:
+            with open("%s-prefill-s%d.stablehlo"
+                      % (prefix, s_bucket), "rb") as f:
+                self._prefill_exp[s_bucket] = jexport.deserialize(f.read())
+        for width in self.decode_widths:
+            with open("%s-decode-w%d.stablehlo"
+                      % (prefix, width), "rb") as f:
+                self._decode_exp[width] = jexport.deserialize(f.read())
+        params_path = prefix + "-params.npz"
+        self._params = None
+        if os.path.exists(params_path):
+            loaded = _np.load(params_path)
+            # one-time H2D, device-resident for the predictor's life
+            self._params = _unflatten_params({
+                n: _io.ensure_staged(loaded[n], source="deploy")
+                for n in self.meta["param_names"]})
+        self._jax = jax
+        self._prefill_call = {}
+        self._decode_call = {}
+
+    # program handles ------------------------------------------------
+    def prefill_bucket(self, prompt_len):
+        """Smallest exported prompt bucket that fits, or a clear error."""
+        from . import io as _io
+        s_bucket = _io.pick_bucket(self.prompt_buckets, prompt_len)
+        if s_bucket is None:
+            raise ValueError(
+                "prompt of %d tokens exceeds the largest exported "
+                "prefill bucket (%d); re-export with bigger "
+                "prompt_buckets" % (prompt_len, self.prompt_buckets[-1]))
+        return s_bucket
+
+    def decode_width(self, pages_needed):
+        from . import io as _io
+        width = _io.pick_bucket(self.decode_widths, pages_needed)
+        if width is None:
+            raise ValueError(
+                "sequence needs %d KV pages, more than the largest "
+                "exported page-table width (%d)"
+                % (pages_needed, self.decode_widths[-1]))
+        return width
+
+    def prefill_fn(self, s_bucket):
+        """Cached jit wrapper for one prefill bucket; the KV pool args
+        are DONATED so the appended-to cache aliases in place."""
+        fn = self._prefill_call.get(s_bucket)
+        if fn is None:
+            exp = self._prefill_exp[s_bucket]
+            fn = self._jax.jit(
+                lambda ps, kk, vv, tokens, lengths, table:
+                exp.call(ps, kk, vv, tokens, lengths, table),
+                donate_argnums=(1, 2))
+            self._prefill_call[s_bucket] = fn
+        return fn
+
+    def decode_fn(self, width):
+        fn = self._decode_call.get(width)
+        if fn is None:
+            exp = self._decode_exp[width]
+            fn = self._jax.jit(
+                lambda ps, kk, vv, token_ids, positions, table:
+                exp.call(ps, kk, vv, token_ids, positions, table),
+                donate_argnums=(1, 2))
+            self._decode_call[width] = fn
+        return fn
+
+    def make_kv(self, num_pages):
+        """Zeroed page pool sized for this artifact's KV spec."""
+        import jax.numpy as jnp
+        kv = self.meta["kv"]
+        shape = (kv["num_layers"], int(num_pages), self.page_size,
+                 kv["num_heads"], kv["head_dim"])
+        dt = jnp.dtype(kv["dtype"])
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    # offline convenience --------------------------------------------
+    def generate(self, prompt, max_new_tokens, eos_id=None, params=None):
+        """Greedy-decode ONE sequence through the exported programs
+        (prefill into a private page pool, then single-token decode
+        steps).  Returns generated ids (eos included when hit) as
+        np.int32 — the exact stream the serving scheduler produces for
+        the same request, minus the batching."""
+        import jax.numpy as jnp
+        ps = params if params is not None else self._params
+        if ps is None:
+            raise ValueError("no params: artifact exported with "
+                             "include_params=False and none were given")
+        prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        max_new = int(max_new_tokens)
+        if plen < 1 or max_new < 1:
+            raise ValueError("need a non-empty prompt and "
+                             "max_new_tokens >= 1")
+        if plen + max_new > self.max_context:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_context "
+                "%d" % (plen, max_new, self.max_context))
+        psz = self.page_size
+        need = _math.ceil((plen + max_new) / psz)
+        kk, vv = self.make_kv(need)
+        pages = _np.arange(need, dtype=_np.int32)
+        sentinel = need
+        s_bucket = self.prefill_bucket(plen)
+        w_s = _math.ceil(s_bucket / psz)
+        tokens = _np.zeros((1, s_bucket), _np.int32)
+        tokens[0, :plen] = prompt
+        table = _np.full((1, w_s), sentinel, _np.int32)
+        table[0, :min(w_s, need)] = pages[:w_s]
+        kk, vv, nxt = self.prefill_fn(s_bucket)(
+            ps, kk, vv, jnp.asarray(tokens),
+            jnp.asarray([plen], jnp.int32), jnp.asarray(table))
+        out = [int(nxt[0])]
+        pos = plen
+        while len(out) < max_new and (eos_id is None
+                                      or out[-1] != int(eos_id)):
+            width = self.decode_width(pos // psz + 1)
+            table = _np.full((1, width), sentinel, _np.int32)
+            table[0, :min(width, need)] = pages[:width]
+            kk, vv, nxt = self.decode_fn(width)(
+                ps, kk, vv, jnp.asarray([out[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32), jnp.asarray(table))
+            out.append(int(nxt[0]))
+            pos += 1
+        return _np.asarray(out, _np.int32)
+
+
+def load_generator(prefix):
+    """Reload a v4 generation artifact (prefill + decode-step program
+    families over a paged KV cache).  Refuses one-shot v1–v3 artifacts —
+    those load with :func:`load_model`."""
+    return GenerationPredictor(prefix)

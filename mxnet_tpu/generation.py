@@ -1,0 +1,693 @@
+"""``mx.serving`` generation engine — token-level continuous batching
+over a paged device-resident KV cache.
+
+Reference: the C predict API's stateful RNN serving
+(include/mxnet/c_predict_api.h MXPredCreatePartialOut + state handles)
+kept one sequence's recurrent state device-resident across calls; the
+TPU-native analog generalizes that to MANY concurrent sequences sharing
+one page pool, scheduled per decode ITERATION (Orca) instead of per
+request, with vLLM-style block-paged KV memory so cache capacity is
+pooled instead of pre-reserved per slot.
+
+Architecture (one :class:`GenerationEngine` thread per generation model,
+run under the same restart supervisor as the one-shot batcher):
+
+  submit ──► admission check ──► FIFO ──► engine loop, per iteration:
+             (bounded queue,              1. harvest expired deadlines
+              breaker state)              2. admit queue head into a free
+                                             decode slot IF the page pool
+                                             covers prompt+max_new pages
+                                             (head-of-line wait otherwise:
+                                             serving.kv_pool_exhausted)
+                                          3. PREFILL each new request
+                                             (B=1 program at its prompt
+                                             bucket) → first token (TTFT)
+                                          4. one DECODE step for all
+                                             active slots (B=slots
+                                             program at the page-table
+                                             width bucket) → next tokens
+                                          5. finished sequences (EOS /
+                                             max_new) resolve futures,
+                                             pages recycle immediately
+
+Key properties:
+
+* **Flat compiles** — programs are AOT-compiled at ``start()``: one
+  prefill program per prompt bucket and one decode program per
+  page-table width, all at fixed batch (1 and ``decode_slots``).  Ragged
+  traffic — any prompt-length mix, mid-flight exits, joins — never
+  reaches the compiler (``tools/check_generation.py`` proves it).
+* **Paged KV memory** — position ``t`` of a sequence lives at slot
+  ``t % page_size`` of page ``table[t // page_size]``; pages come from a
+  shared free list and return to it the iteration their sequence
+  finishes.  The pool dimension is symbolic in the v4 artifact, so
+  ``serving.kv_pages`` is a pure runtime choice.
+* **Bitwise parity** — the token stream each request receives is bitwise
+  equal to the eager greedy oracle
+  (``models.TransformerLM.greedy_decode``) regardless of what else is in
+  flight: prefill runs the exact ``apply()`` attention math and the
+  decode step's masked paged attention contributes exact zeros for
+  padding (kernels.paged_attention).
+* **Donated pool** — the page pool is donated into every program call
+  (it is the only O(pool) buffer); a dispatch failure therefore poisons
+  it, so the engine fails every in-flight sequence with the causal
+  error, rebuilds the pool zeroed, feeds the model's circuit breaker and
+  keeps serving.
+* **PR-7 fault tolerance per slot** — admission sheds past
+  ``serving.max_pending`` (ServerOverloadedError), queued requests whose
+  deadline lapses complete typed and never prefill
+  (DeadlineExceededError), an open breaker fails submits fast
+  (CircuitOpenError), and the engine thread restarts under the
+  ``mx.resilience`` budget.
+
+Telemetry: ``serving.tokens_generated[.model]`` counters,
+``serving.kv_pages_in_use.<model>`` gauge, ``serving.prefill_ms`` /
+``serving.decode_step_ms`` / ``serving.ttft_ms`` /
+``serving.generate_request_ms`` timers,
+``serving.kv_pool_exhausted[.model]`` counters, and one
+``serving_generate`` JSONL record per finished request (prompt_len,
+new_tokens, ttft_ms, wall_ms — ``tools/telemetry_report.py`` folds these
+into per-model TTFT/tokens-per-second columns and the
+``kv_pool_exhaustion`` anomaly).
+
+Knobs (config.py): ``serving.kv_page_size`` (baked at export),
+``serving.kv_pages``, ``serving.decode_slots``; docs/SERVING.md
+"Generation" has the full walkthrough.
+"""
+from __future__ import annotations
+
+import logging
+import math as _math
+import threading
+import time as _time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+import jax
+
+from . import config as _config
+from . import io as _io
+from . import telemetry as _telemetry
+from .serving import (CircuitOpenError, DeadlineExceededError,
+                      ServerOverloadedError, ServingError)
+
+__all__ = ["GenerationEngine"]
+
+_LOG = logging.getLogger("mxnet_tpu.generation")
+
+
+class _EngineCrashError(OSError):
+    """Internal: wraps an engine-loop crash so
+    ``resilience.call_with_retry`` drives the restart backoff."""
+
+
+class _GenRequest:
+    """One generation request: prompt + budget + the future its token
+    stream resolves, stamped for TTFT / deadline accounting."""
+
+    __slots__ = ("prompt", "plen", "max_new", "eos_id", "future",
+                 "t_submit", "deadline", "need", "stall_counted")
+
+    def __init__(self, prompt, max_new, eos_id, deadline_ms, need):
+        self.prompt = prompt
+        self.plen = int(prompt.shape[0])
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.future = Future()
+        self.t_submit = _time.perf_counter()
+        self.deadline = (self.t_submit + float(deadline_ms) * 1e-3) \
+            if deadline_ms and deadline_ms > 0 else None
+        self.need = int(need)          # pages for prompt + max_new
+        self.stall_counted = False     # kv_pool_exhausted counted once
+
+    def expired(self, now):
+        return self.deadline is not None and now >= self.deadline
+
+
+class _Slot:
+    """One active decode slot: the sequence's pages, cached length and
+    generated tokens.  Engine-thread-only state."""
+
+    __slots__ = ("req", "pages", "pos", "tokens", "ttft_ms")
+
+    def __init__(self, req, pages):
+        self.req = req
+        self.pages = pages
+        self.pos = req.plen      # tokens already in the cache
+        self.tokens = []
+        self.ttft_ms = None
+
+
+class GenerationEngine:
+    """Per-model continuous-batching generation scheduler (one thread).
+
+    Owned by :class:`mxnet_tpu.serving.Server` (``register(...,
+    generate=True)``); drives a :class:`mxnet_tpu.deploy
+    .GenerationPredictor`'s prefill/decode program families over a
+    shared page pool."""
+
+    def __init__(self, name, predictor, breaker=None, num_pages=None,
+                 decode_slots=None, max_pending=None,
+                 default_deadline_ms=None):
+        self.name = name
+        self.predictor = predictor
+        self.breaker = breaker
+        self.num_pages = int(num_pages if num_pages is not None
+                             else _config.get("serving.kv_pages"))
+        self.decode_slots = int(decode_slots if decode_slots is not None
+                                else _config.get("serving.decode_slots"))
+        self.max_pending = int(max_pending if max_pending is not None
+                               else _config.get("serving.max_pending"))
+        self.default_deadline_ms = float(
+            default_deadline_ms if default_deadline_ms is not None
+            else _config.get("serving.default_deadline_ms"))
+        psz = predictor.page_size
+        # a single request may never need more pages than the pool holds
+        self.max_need = min(self.num_pages,
+                            _math.ceil(predictor.max_context / psz))
+        if self.max_need < 1:
+            raise ServingError(
+                "model %r: serving.kv_pages=%d cannot hold one page"
+                % (name, self.num_pages))
+        # Cross-thread state (submit side vs engine thread) — the same
+        # lock-discipline contract tools/mxlint.py checks on the Server.
+        self._queue = deque()            # guarded-by: _cond
+        self._free = list(range(self.num_pages))  # guarded-by: _cond
+        self._cond = threading.Condition()
+        self._started = False            # guarded-by: _cond
+        self._stopping = False           # guarded-by: _cond
+        self._abort = False              # guarded-by: _cond
+        self._dead = None                # guarded-by: _cond — crash exc
+        # guarded-by[writes]: _cond — stop() joins outside the lock
+        self._thread = None
+        # Engine-thread-only state: the page pool arrays and decode slots
+        # are touched exclusively by the engine loop — no lock.
+        self._slots = [None] * self.decode_slots
+        self._kk = None
+        self._vv = None
+        self._prefill = {}    # prompt bucket -> compiled program
+        self._decode = {}     # page-table width -> compiled program
+
+    # ----------------------------------------------------------- compile
+    def _compile_programs(self):
+        """AOT-compile the full program family: one prefill per prompt
+        bucket (B=1) and one decode step per page-table width
+        (B=decode_slots).  This is the ENTIRE compiled set — ragged
+        generation traffic never adds to it (``serving.compiles`` stays
+        equal to the family size, the check_generation.py gate)."""
+        from . import perf as _perf
+        from . import tracing as _tracing
+        gp = self.predictor
+        params = gp._params
+        pspec = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+        kv = gp.meta["kv"]
+        pool_shape = (kv["num_layers"], self.num_pages, gp.page_size,
+                      kv["num_heads"], kv["head_dim"])
+        kspec = jax.ShapeDtypeStruct(pool_shape, gp.kv_dtype)
+        i32 = _np.int32
+
+        def compile_one(fn, arg_specs, label):
+            t0 = _time.perf_counter()
+            with _tracing.span("serving.compile", cat="serving",
+                               model=self.name, program=label):
+                traced = fn.trace(*arg_specs)
+                t1 = _time.perf_counter()
+                lowered = traced.lower()
+                t2 = _time.perf_counter()
+                program = lowered.compile()
+                t3 = _time.perf_counter()
+            _telemetry.counter("serving.compiles").inc()
+            _telemetry.timer("serving.compile_ms").observe(
+                (t3 - t0) * 1e3)
+            _perf.register_compiled(
+                "serving", "%s/%s" % (self.name, label), program,
+                phases_ms={"trace_ms": (t1 - t0) * 1e3,
+                           "lower_ms": (t2 - t1) * 1e3,
+                           "compile_ms": (t3 - t2) * 1e3},
+                dtype=str(gp.kv_dtype))
+            return program
+
+        for s_bucket in gp.prompt_buckets:
+            if s_bucket in self._prefill:
+                continue
+            w_s = _math.ceil(s_bucket / gp.page_size)
+            self._prefill[s_bucket] = compile_one(
+                gp.prefill_fn(s_bucket),
+                (pspec, kspec, kspec,
+                 jax.ShapeDtypeStruct((1, s_bucket), i32),
+                 jax.ShapeDtypeStruct((1,), i32),
+                 jax.ShapeDtypeStruct((1, w_s), i32)),
+                "prefill-s%d" % s_bucket)
+        for width in gp.decode_widths:
+            if width in self._decode:
+                continue
+            self._decode[width] = compile_one(
+                gp.decode_fn(width),
+                (pspec, kspec, kspec,
+                 jax.ShapeDtypeStruct((self.decode_slots,), i32),
+                 jax.ShapeDtypeStruct((self.decode_slots,), i32),
+                 jax.ShapeDtypeStruct((self.decode_slots, width), i32)),
+                "decode-w%d" % width)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        from . import tracing as _tracing
+        with self._cond:
+            if self._started:
+                return self
+        self._compile_programs()
+        self._kk, self._vv = self.predictor.make_kv(self.num_pages)
+        with self._cond:
+            self._stopping = False
+            self._abort = False
+            self._dead = None
+            self._started = True
+            self._thread = threading.Thread(
+                target=_tracing.wrap_context(self._supervise), daemon=True,
+                name="mx-serving-generate-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout_s=30.0):
+        """Stop the engine.  With ``drain`` (default) queued requests
+        prefill and every in-flight sequence runs to completion; with
+        ``drain=False`` queued AND active sequences fail promptly."""
+        with self._cond:
+            if not self._started:
+                return
+            self._stopping = True
+            self._abort = self._abort or not drain
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+            if thread.is_alive():
+                _telemetry.counter("serving.stop_timeout").inc()
+                _LOG.warning("serving: generation engine %r did not "
+                             "drain within %.1fs", self.name, timeout_s)
+        with self._cond:
+            self._started = False
+            self._thread = None
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               deadline_ms=None):
+        """Enqueue one prompt; returns a Future resolving to the
+        generated token ids (np.int32, EOS included when hit) — the
+        bitwise ``greedy_decode`` stream."""
+        gp = self.predictor
+        prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        max_new = int(max_new_tokens)
+        if plen < 1 or max_new < 1:
+            raise ValueError(
+                "model %r: need a non-empty prompt and max_new_tokens "
+                ">= 1" % (self.name,))
+        if plen + max_new > gp.max_context:
+            raise ValueError(
+                "model %r: prompt (%d) + max_new_tokens (%d) exceeds the "
+                "artifact's max_context %d"
+                % (self.name, plen, max_new, gp.max_context))
+        gp.prefill_bucket(plen)   # raises if no bucket fits
+        need = _math.ceil((plen + max_new) / gp.page_size)
+        if need > self.max_need:
+            raise ValueError(
+                "model %r: request needs %d KV pages but the pool holds "
+                "%d (serving.kv_pages) — shorten the request or grow the "
+                "pool" % (self.name, need, self.num_pages))
+        _telemetry.counter("serving.requests").inc()
+        breaker = self.breaker
+        if breaker is not None and breaker.rejects_submit():
+            _telemetry.counter("serving.breaker_rejected").inc()
+            raise CircuitOpenError(
+                "model %r circuit breaker is OPEN after %d consecutive "
+                "dispatch failure(s); failing fast for %.0fms more"
+                % (self.name, breaker.failures,
+                   breaker.cooldown_remaining_ms()))
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        req = _GenRequest(prompt, max_new, eos_id,
+                          float(deadline_ms or 0.0), need)
+        with self._cond:
+            if self._dead is not None:
+                exc = self._dead
+                raise ServingError(
+                    "generation engine for model %r crashed (%s: %s) and "
+                    "exhausted its restart budget; submit rejected"
+                    % (self.name, type(exc).__name__, exc))
+            if self._stopping or not self._started:
+                raise ServingError(
+                    "generation engine for model %r is %s; submit "
+                    "rejected" % (self.name, "stopping" if self._stopping
+                                  else "not started"))
+            if self.max_pending > 0 \
+                    and len(self._queue) >= self.max_pending:
+                shed = True
+            else:
+                shed = False
+                self._queue.append(req)
+                self._cond.notify_all()
+        if shed:
+            _telemetry.counter("serving.shed_requests").inc()
+            _telemetry.counter(
+                "serving.shed_requests.%s" % self.name).inc()
+            raise ServerOverloadedError(
+                "generation queue for model %r is at serving.max_pending"
+                "=%d; request shed — back off and retry"
+                % (self.name, self.max_pending))
+        return req.future
+
+    # ----------------------------------------------------------- the loop
+    def _supervise(self):
+        from . import resilience as _resilience
+        try:
+            _resilience.call_with_retry(self._run_engine,
+                                        kind="serving_batcher")
+        except BaseException as exc:  # noqa: BLE001 — budget exhausted
+            cause = exc.__cause__ if exc.__cause__ is not None else exc
+            with self._cond:
+                self._dead = cause
+                queued = list(self._queue)
+                self._queue.clear()
+                self._cond.notify_all()
+            self._fail_all(queued, cause)
+            _LOG.error(
+                "serving: generation engine %r crashed and exhausted its "
+                "restart budget (%s: %s); submits now fail fast",
+                self.name, type(cause).__name__, cause)
+
+    def _run_engine(self):
+        try:
+            self._loop()
+        except BaseException as exc:  # noqa: BLE001 — supervised crash
+            _telemetry.counter("serving.batcher_crashes").inc()
+            with self._cond:
+                queued = list(self._queue)
+                self._queue.clear()
+            self._fail_all(queued, exc)
+            self._fail_active(exc)
+            _LOG.warning(
+                "serving: generation engine %r crashed (%s: %s); "
+                "restarting under the resilience retry budget",
+                self.name, type(exc).__name__, exc)
+            raise _EngineCrashError(
+                "generation engine crashed: %s: %s"
+                % (type(exc).__name__, exc)) from exc
+
+    def _active(self):
+        return [s for s in self._slots if s is not None]
+
+    def _fail_all(self, reqs, exc):
+        for req in reqs:
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    def _fail_active(self, exc):
+        """Fail every in-flight sequence and recycle its pages (the pool
+        arrays were donated into the failed dispatch, so their state is
+        gone — rebuild zeroed)."""
+        freed = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._slots[i] = None
+            freed.extend(slot.pages)
+            if not slot.req.future.done():
+                slot.req.future.set_exception(exc)
+        if freed:
+            with self._cond:
+                self._free.extend(freed)
+                self._cond.notify_all()
+        self._gauge_pages()
+        self._kk, self._vv = self.predictor.make_kv(self.num_pages)
+
+    def _gauge_pages(self):
+        with self._cond:
+            in_use = self.num_pages - len(self._free)
+        _telemetry.gauge(
+            "serving.kv_pages_in_use.%s" % self.name).set(in_use)
+
+    def _harvest_expired_locked(self, now):  # mxlint: holds(_cond)
+        dead = [r for r in self._queue if r.expired(now)]
+        for req in dead:
+            self._queue.remove(req)
+        return dead
+
+    def _admit_locked(self, now):  # mxlint: holds(_cond)
+        """Pop queue-head requests into free slots while the page pool
+        covers them.  FIFO: a head request the pool cannot cover BLOCKS
+        later ones (no starvation of long requests) and counts one
+        ``serving.kv_pool_exhausted`` per stall episode."""
+        admitted = []
+        free_slots = [i for i, s in enumerate(self._slots) if s is None]
+        while self._queue and free_slots:
+            req = self._queue[0]
+            if req.need > len(self._free):
+                if not req.stall_counted:
+                    req.stall_counted = True
+                    _telemetry.counter("serving.kv_pool_exhausted").inc()
+                    _telemetry.counter(
+                        "serving.kv_pool_exhausted.%s" % self.name).inc()
+                break
+            self._queue.popleft()
+            pages = [self._free.pop() for _ in range(req.need)]
+            self._slots[free_slots.pop(0)] = _Slot(req, pages)
+            admitted.append(req)
+        return admitted
+
+    def _loop(self):
+        while True:
+            now = _time.perf_counter()
+            with self._cond:
+                expired = self._harvest_expired_locked(now)
+                admitted = self._admit_locked(now)
+                active = self._active()
+                if not admitted and not active:
+                    if self._stopping and (self._abort
+                                           or not self._queue):
+                        queued = list(self._queue)
+                        self._queue.clear()
+                        abort = self._abort
+                    else:
+                        self._cond.wait(timeout=0.05)
+                        queued = None
+                        abort = False
+                else:
+                    queued = None
+                    abort = False
+            self._expire(expired)
+            if queued is not None:
+                if abort:
+                    self._fail_all(queued, ServingError(
+                        "generation engine stopped without drain"))
+                return
+            if not admitted and not active:
+                continue
+            with self._cond:
+                abort = self._abort
+            if abort:
+                with self._cond:
+                    queued = list(self._queue)
+                    self._queue.clear()
+                exc = ServingError(
+                    "generation engine stopped without drain")
+                self._fail_all(queued, exc)
+                self._fail_active(exc)
+                return
+            self._gauge_pages()
+            ok = True
+            for req in admitted:
+                if not self._dispatch_prefill(req):
+                    ok = False
+                    break
+            if ok and self._active():
+                self._dispatch_decode()
+
+    def _expire(self, reqs):
+        for req in reqs:
+            _telemetry.counter("serving.deadline_exceeded").inc()
+            _telemetry.counter(
+                "serving.deadline_exceeded.%s" % self.name).inc()
+            if not req.future.done():
+                req.future.set_exception(DeadlineExceededError(
+                    "generation request for model %r expired in queue "
+                    "before prefill (queued %.1fms, deadline passed)"
+                    % (self.name, (_time.perf_counter() - req.t_submit)
+                       * 1e3)))
+
+    def _dispatch_failed(self, exc):
+        """Shared failure path: the donated pool is poisoned, so every
+        in-flight sequence fails with the causal error and the breaker
+        records the failure.  Returns False for the caller to bail."""
+        _telemetry.counter("serving.dispatch_errors").inc()
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        self._fail_active(exc)
+        return False
+
+    def _dispatch_prefill(self, req):
+        """Run one admitted request's prompt through its bucket's prefill
+        program: seeds the shared pool (scatter touches only this
+        request's pages, so in-flight sequences are untouched — the
+        mid-flight JOIN) and produces the first token (TTFT)."""
+        gp = self.predictor
+        slot_idx = next(i for i, s in enumerate(self._slots)
+                        if s is not None and s.req is req)
+        slot = self._slots[slot_idx]
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow_dispatch():
+            self._slots[slot_idx] = None
+            with self._cond:
+                self._free.extend(slot.pages)
+                self._cond.notify_all()
+            if not req.future.done():
+                req.future.set_exception(CircuitOpenError(
+                    "model %r circuit breaker is OPEN; prefill failed "
+                    "fast, retry after the cooldown" % (self.name,)))
+            return True   # engine itself is fine
+        s_bucket = gp.prefill_bucket(req.plen)
+        w_s = _math.ceil(s_bucket / gp.page_size)
+        sentinel = self.num_pages
+        tokens = _np.zeros((1, s_bucket), _np.int32)
+        tokens[0, :req.plen] = req.prompt
+        table = _np.full((1, w_s), sentinel, _np.int32)
+        k = min(w_s, len(slot.pages))
+        table[0, :k] = slot.pages[:k]
+        t0 = _time.perf_counter()
+        try:
+            self._kk, self._vv, nxt = self._prefill[s_bucket](
+                gp._params, self._kk, self._vv, tokens,
+                _np.asarray([req.plen], _np.int32), table)
+            first = int(nxt[0])
+        except BaseException as exc:  # noqa: BLE001 — pool donated away
+            return self._dispatch_failed(exc)
+        t1 = _time.perf_counter()
+        if breaker is not None:
+            breaker.record_success()
+        slot.tokens.append(first)
+        slot.ttft_ms = (t1 - req.t_submit) * 1e3
+        _telemetry.timer("serving.prefill_ms").observe((t1 - t0) * 1e3)
+        _telemetry.timer("serving.ttft_ms").observe(slot.ttft_ms)
+        self._count_tokens(1)
+        self._maybe_finish(slot_idx)
+        return True
+
+    def _dispatch_decode(self):
+        """One decode iteration for every active slot.  The page-table
+        width buckets to the widest need among active sequences; inactive
+        slots ride along on the all-sentinel row (writes drop, output
+        ignored) — that is what keeps the compiled set flat while
+        sequences EXIT and JOIN mid-flight."""
+        gp = self.predictor
+        B = self.decode_slots
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow_dispatch():
+            exc = CircuitOpenError(
+                "model %r circuit breaker is OPEN; in-flight decode "
+                "failed fast, retry after the cooldown" % (self.name,))
+            for i, _ in active:
+                self._slots[i] = None
+            freed = []
+            for _, s in active:
+                freed.extend(s.pages)
+                if not s.req.future.done():
+                    s.req.future.set_exception(exc)
+            with self._cond:
+                self._free.extend(freed)
+                self._cond.notify_all()
+            self._gauge_pages()
+            return
+        width = _io.pick_bucket(
+            gp.decode_widths, max(len(s.pages) for _, s in active))
+        sentinel = self.num_pages
+        token_ids = _np.zeros((B,), _np.int32)
+        positions = _np.zeros((B,), _np.int32)
+        table = _np.full((B, width), sentinel, _np.int32)
+        for i, s in active:
+            token_ids[i] = s.tokens[-1]
+            positions[i] = s.pos
+            k = min(width, len(s.pages))
+            table[i, :k] = s.pages[:k]
+        t0 = _time.perf_counter()
+        try:
+            self._kk, self._vv, nxt = self._decode[width](
+                gp._params, self._kk, self._vv, token_ids, positions,
+                table)
+            nxt = _np.asarray(nxt)
+        except BaseException as exc:  # noqa: BLE001 — pool donated away
+            self._dispatch_failed(exc)
+            return
+        t1 = _time.perf_counter()
+        if breaker is not None:
+            breaker.record_success()
+        _telemetry.timer("serving.decode_step_ms").observe(
+            (t1 - t0) * 1e3)
+        self._count_tokens(len(active))
+        for i, s in active:
+            s.tokens.append(int(nxt[i]))
+            s.pos += 1
+            self._maybe_finish(i)
+
+    def _count_tokens(self, n):
+        _telemetry.counter("serving.tokens_generated").inc(n)
+        _telemetry.counter(
+            "serving.tokens_generated.%s" % self.name).inc(n)
+
+    def _maybe_finish(self, slot_idx):
+        """Mid-flight EXIT: resolve the future and recycle the pages the
+        same iteration the sequence hits EOS or its token budget."""
+        slot = self._slots[slot_idx]
+        req = slot.req
+        done = len(slot.tokens) >= req.max_new or (
+            req.eos_id is not None
+            and slot.tokens[-1] == int(req.eos_id))
+        if not done:
+            return
+        self._slots[slot_idx] = None
+        with self._cond:
+            self._free.extend(slot.pages)
+            self._cond.notify_all()
+        self._gauge_pages()
+        t1 = _time.perf_counter()
+        wall_ms = (t1 - req.t_submit) * 1e3
+        _telemetry.timer("serving.generate_request_ms").observe(wall_ms)
+        if not req.future.done():
+            req.future.set_result(_np.asarray(slot.tokens, _np.int32))
+        if _telemetry.enabled():
+            _telemetry.log_event(
+                "serving_generate", model=self.name,
+                prompt_len=req.plen, new_tokens=len(slot.tokens),
+                max_new=req.max_new, pages=len(slot.pages),
+                ttft_ms=round(slot.ttft_ms, 4)
+                if slot.ttft_ms is not None else None,
+                wall_ms=round(wall_ms, 4),
+                pool_exhausted_wait=req.stall_counted,
+                breaker=self.breaker.state
+                if self.breaker is not None else "closed")
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        with self._cond:
+            queued = len(self._queue)
+            free = len(self._free)
+            thread = self._thread
+        return {
+            "queued": queued,
+            "active": len(self._active()),
+            "decode_slots": self.decode_slots,
+            "kv_pages": self.num_pages,
+            "kv_pages_free": free,
+            "page_size": self.predictor.page_size,
+            "max_context": self.predictor.max_context,
+            "prompt_buckets": list(self.predictor.prompt_buckets),
+            "decode_widths": list(self.predictor.decode_widths),
+            "engine_alive": bool(thread is not None
+                                 and thread.is_alive()),
+            "breaker": self.breaker.state
+            if self.breaker is not None else "closed",
+        }

@@ -33,9 +33,10 @@ from . import telemetry as _telemetry
 from .ops.pallas_kernels import (flash_attention, fused_adam_step,
                                  fused_sgd_step)
 
-__all__ = ["enabled", "attention", "flash_unsupported_reason",
-           "fused_step_enabled", "flash_attention", "fused_sgd_step",
-           "fused_adam_step", "measure"]
+__all__ = ["enabled", "attention", "paged_attention",
+           "flash_unsupported_reason", "fused_step_enabled",
+           "flash_attention", "fused_sgd_step", "fused_adam_step",
+           "measure"]
 
 # one-row VMEM feasibility: a q block keeps its head's full K and V
 # resident, so 2 * Skv * D * itemsize must fit the budget
@@ -112,6 +113,39 @@ def attention(q, k, v, causal=False, scale=None):
             return flash_attention(q, k, v, causal=causal, scale=scale)
         _telemetry.counter("kernels.fallback").inc()
     return _xla_attention(q, k, v, causal=causal, scale=scale)
+
+
+def paged_attention(q, k, v, valid, scale=None):
+    """Decode-step attention over a page-gathered context window.
+
+    ``q`` is the single new query ``[B, H, 1, Dh]``; ``k``/``v`` are the
+    context gathered through a request's page table ``[B, H, K, Dh]``
+    (``K = page_table_width * page_size``, so slots past the sequence's
+    true length hold stale or clipped-sentinel data); ``valid`` ``[B, K]``
+    masks exactly the real positions.  The math mirrors the XLA
+    attention lowering (``parallel.ring_attention._block_attn``): masked
+    scores pin to the same ``-1e30`` floor, so masked keys contribute an
+    EXACT ``0.0`` to both the softmax denominator and the value sum and
+    the result tracks an unpadded forward bitwise-closely enough for
+    greedy token parity (tools/check_generation.py enforces it).
+
+    Routing: this is the seam where a Pallas paged-attention kernel will
+    plug in; today every call takes the XLA lowering and, with the
+    kernel tier on, counts ``kernels.paged_fallback`` so the routing
+    table stays observable."""
+    if enabled():
+        _telemetry.counter("kernels.paged_fallback").inc()
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", e.astype(v.dtype), v)
+    return (o / l.astype(o.dtype)).astype(q.dtype)
 
 
 def measure(key, fn, *args):

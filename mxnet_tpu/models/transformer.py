@@ -130,21 +130,19 @@ class TransformerLM:
         # this IS the plain XLA attention lowering
         return _kernels.attention(q, k, v, causal=self.cfg.causal)
 
-    def _layer(self, x, lp):
-        cfg = self.cfg
-        B, S, D = x.shape
-        H, Dh = cfg.num_heads, cfg.head_dim
-
+    def _qkv(self, x, lp):
+        """ln1 + fused QKV projection: x [B,S,D] -> q,k,v [B,H,S,Dh]."""
         h = _norm(x, lp["ln1"])
         qkv = jnp.einsum("bsd,dche->bsche", h, lp["wqkv"],
                          preferred_element_type=jnp.float32).astype(x.dtype)
         q = jnp.transpose(qkv[:, :, 0], (0, 2, 1, 3))   # [B,H,S,Dh]
         k = jnp.transpose(qkv[:, :, 1], (0, 2, 1, 3))
         v = jnp.transpose(qkv[:, :, 2], (0, 2, 1, 3))
-        q = self._constrain(q, self._dp, self._tp, self._sp, None)
-        k = self._constrain(k, self._dp, self._tp, self._sp, None)
-        v = self._constrain(v, self._dp, self._tp, self._sp, None)
-        o = self._attention(q, k, v)                    # [B,H,S,Dh]
+        return q, k, v
+
+    def _attn_mlp(self, x, o, lp):
+        """Output projection + residual + MLP half of one layer; ``o`` is
+        the attention output [B,H,S,Dh]."""
         o = jnp.einsum("bhse,hed->bsd", o, lp["wo"],
                        preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + o
@@ -159,6 +157,19 @@ class TransformerLM:
                        preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + d
         return self._constrain(x, self._dp, self._sp, None)
+
+    def _layer(self, x, lp, kv_sink=None):
+        q, k, v = self._qkv(x, lp)
+        if kv_sink is not None:
+            # generation prefill: the per-layer K/V stream is ALSO written
+            # into the paged cache; the attention math below is untouched,
+            # which is what keeps prefill logits on the eager apply() path
+            kv_sink(k, v)
+        q = self._constrain(q, self._dp, self._tp, self._sp, None)
+        k = self._constrain(k, self._dp, self._tp, self._sp, None)
+        v = self._constrain(v, self._dp, self._tp, self._sp, None)
+        o = self._attention(q, k, v)                    # [B,H,S,Dh]
+        return self._attn_mlp(x, o, lp)
 
     def run_stack(self, params, x):
         """Shared encoder body: sharding constraint -> scanned layers ->
@@ -191,3 +202,170 @@ class TransformerLM:
         gold = jnp.take_along_axis(
             logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
         return jnp.mean(logz - gold)
+
+    # --------------------------------------------- generation (paged KV)
+    # Autoregressive serving state (docs/SERVING.md "Generation"): the KV
+    # cache is a POOL of fixed-size pages shared by every in-flight
+    # sequence; each sequence owns a page-table row of page ids.  Position
+    # t of a sequence lives in page ``table[t // page_size]`` at slot
+    # ``t % page_size``.  A page id >= num_pages is the SENTINEL: writes
+    # through it drop (jax scatter mode="drop") and gathers through it
+    # clip to a real page whose rows the position mask then zeroes out —
+    # padded table entries and inactive decode slots are branch-free.
+
+    def kv_spec(self):
+        """Static description of one model's page pool — what deploy.py
+        stamps into the v4 meta so a server can allocate the pool without
+        reconstructing the model."""
+        cfg = self.cfg
+        return {"num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+                "head_dim": cfg.head_dim, "dtype": jnp.dtype(cfg.dtype).name}
+
+    def init_kv_pages(self, num_pages, page_size):
+        """Zeroed device page pool: {"k","v"} of
+        [L, num_pages, page_size, H, Dh] in the model dtype."""
+        cfg = self.cfg
+        shape = (cfg.num_layers, int(num_pages), int(page_size),
+                 cfg.num_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+
+    def _logits_last(self, params, x):
+        """Final norm + tied-embedding readout for one position per row:
+        x [B, D] -> greedy next-token ids [B] int32."""
+        x = _norm(x, params["final_norm"])
+        logits = jnp.einsum("bd,vd->bv", x, params["embed"],
+                            preferred_element_type=jnp.float32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def prefill(self, params, kv, tokens, lengths, page_table, page_size):
+        """Process whole prompts and seed the paged cache.
+
+        tokens [B, S] int32 (rows padded past ``lengths`` with anything),
+        lengths [B] int32 true prompt lengths, page_table [B, W] int32
+        with W*page_size >= S.  Runs the standard causal stack — the
+        attention seen by position ``lengths-1`` is exactly ``apply()``'s,
+        so the returned greedy next token matches the eager oracle —
+        while every layer's K/V stream is scattered into the page pool.
+        Returns ``(new_kv, next_token[B] int32)``.
+        """
+        cfg = self.cfg
+        B, S = tokens.shape
+        psz = int(page_size)
+        pool = kv["k"].shape[1]
+        x = (params["embed"][tokens]
+             + params["pos_embed"][:S][None]).astype(cfg.dtype)
+        x = self._constrain(x, self._dp, self._sp, None)
+
+        iota = jnp.arange(S, dtype=jnp.int32)
+        pages = page_table[:, iota // psz]                    # [B, S]
+        # positions past the true prompt length write through the OOB
+        # sentinel and are dropped
+        pages = jnp.where(iota[None, :] < lengths[:, None], pages, pool)
+        slots = jnp.broadcast_to(iota % psz, (B, S))
+
+        def body(carry, xs):
+            lp, kl, vl = xs
+            new = {}
+
+            def sink(k, v):
+                # [B,H,S,Dh] -> [B,S,H,Dh] page-slot scatter
+                new["k"] = kl.at[pages, slots].set(
+                    jnp.transpose(k, (0, 2, 1, 3)).astype(kl.dtype),
+                    mode="drop")
+                new["v"] = vl.at[pages, slots].set(
+                    jnp.transpose(v, (0, 2, 1, 3)).astype(vl.dtype),
+                    mode="drop")
+
+            out = self._layer(carry, lp, kv_sink=sink)
+            return out, (new["k"], new["v"])
+
+        x, (nk, nv) = _runtime.scan_stack(
+            body, x, (params["layers"], kv["k"], kv["v"]))
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None]
+            .astype(jnp.int32), axis=1)[:, 0]                 # [B, D]
+        return {"k": nk, "v": nv}, self._logits_last(params, last)
+
+    def decode_step(self, params, kv, token_ids, positions, page_table,
+                    page_size):
+        """One generation iteration for a whole decode batch.
+
+        token_ids [B] int32 (the token to append), positions [B] int32
+        (its position = tokens already cached), page_table [B, W] int32.
+        Appends each token's K/V to its page, attends through the page
+        table over positions <= its own, and returns
+        ``(new_kv, next_token[B] int32)``.  Inactive slots pass the
+        sentinel page everywhere: their write drops and their output is
+        garbage the scheduler ignores.
+        """
+        cfg = self.cfg
+        B = token_ids.shape[0]
+        W = page_table.shape[1]
+        psz = int(page_size)
+        H, Dh = cfg.num_heads, cfg.head_dim
+        x = (params["embed"][token_ids]
+             + params["pos_embed"][positions]).astype(cfg.dtype)[:, None]
+        page = jnp.take_along_axis(
+            page_table, (positions // psz)[:, None], axis=1)  # [B,1]
+        slot = (positions % psz)[:, None]                     # [B,1]
+        valid = jnp.arange(W * psz, dtype=jnp.int32)[None, :] \
+            <= positions[:, None]                             # [B, K]
+
+        def body(carry, xs):
+            lp, kl, vl = xs
+            q, k, v = self._qkv(carry, lp)                    # [B,H,1,Dh]
+            kl = kl.at[page, slot].set(
+                jnp.transpose(k, (0, 2, 1, 3)).astype(kl.dtype),
+                mode="drop")
+            vl = vl.at[page, slot].set(
+                jnp.transpose(v, (0, 2, 1, 3)).astype(vl.dtype),
+                mode="drop")
+            # context through the page table (sentinel entries clip to a
+            # real page; `valid` masks them out of the softmax exactly)
+            kc = jnp.transpose(
+                kl[page_table].reshape(B, W * psz, H, Dh), (0, 2, 1, 3))
+            vc = jnp.transpose(
+                vl[page_table].reshape(B, W * psz, H, Dh), (0, 2, 1, 3))
+            o = _kernels.paged_attention(q, kc, vc, valid)
+            return self._attn_mlp(carry, o, lp), (kl, vl)
+
+        x, (nk, nv) = _runtime.scan_stack(
+            body, x, (params["layers"], kv["k"], kv["v"]))
+        return {"k": nk, "v": nv}, self._logits_last(params, x[:, 0])
+
+    def greedy_decode(self, params, prompt, max_new_tokens, eos_id=None):
+        """Cache-free greedy-decode reference: a FULL re-forward of the
+        whole sequence per token.  The bitwise parity oracle for the
+        prefill + decode-step path (tools/check_generation.py) — slow by
+        design, trust anchor only.  The sequence is zero-padded to
+        ``cfg.max_len`` so every re-forward reuses ONE compiled program;
+        causal attention's masked keys contribute exact zeros, so the
+        logits at real positions are bitwise those of the unpadded
+        forward.  ``prompt`` is a 1-D int sequence; returns the generated
+        ids (eos included when hit) as np.int32."""
+        import numpy as _np
+        S = self.cfg.max_len
+        fwd = getattr(self, "_oracle_fwd", None)
+        if fwd is None:
+            fwd = self._oracle_fwd = jax.jit(
+                lambda ps, toks: self.apply(ps, toks))
+        toks = _np.asarray(prompt, _np.int32).reshape(-1)
+        n = int(toks.shape[0])
+        if n + int(max_new_tokens) > S:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_len %d"
+                % (n, max_new_tokens, S))
+        buf = _np.zeros((1, S), _np.int32)
+        buf[0, :n] = toks
+        out = []
+        for _ in range(int(max_new_tokens)):
+            logits = fwd(params, jnp.asarray(buf))
+            nxt = int(jnp.argmax(logits[0, n - 1]))
+            out.append(nxt)
+            if n < S:
+                buf[0, n] = nxt
+            n += 1
+            if eos_id is not None and nxt == int(eos_id):
+                break
+        return _np.asarray(out, _np.int32)

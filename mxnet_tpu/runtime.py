@@ -139,10 +139,18 @@ def scan_stack(body, carry, xs):
         else:
             body = jax.checkpoint(body, policy=policy)
     if mode == "unroll":
+        import jax.numpy as jnp
         leaves = jax.tree_util.tree_leaves(xs)
         n = leaves[0].shape[0]
+        ys = []
         for i in range(n):
             x = jax.tree_util.tree_map(lambda a: a[i], xs)
-            carry, _ = body(carry, x)
-        return carry, None
+            carry, y = body(carry, x)
+            ys.append(y)
+        if ys and ys[0] is None:
+            return carry, None
+        # stack per-layer outputs like lax.scan does (the paged KV-cache
+        # writes of models/transformer.py ride the layer scan as ys)
+        return carry, jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *ys)
     return lax.scan(body, carry, xs)

@@ -405,6 +405,7 @@ class Server:
         # (docs/ANALYSIS.md): every access must hold _cond unless the
         # annotation says writes-only.
         self._models = OrderedDict()     # guarded-by: _cond — _ModelEntry, LRU order
+        self._generation = {}            # guarded-by: _cond — GenerationEngine per model
         self._pending = deque()          # guarded-by: _cond
         self._cond = threading.Condition()
         # guarded-by[writes]: _cond — stop() joins outside the lock
@@ -423,7 +424,7 @@ class Server:
         # 'off' (natural shapes) degenerates to the single full bucket
         return sizes or (cap,)
 
-    def register(self, name, prefix, quantized=False):
+    def register(self, name, prefix, quantized=False, generate=False):
         """Load the ``mx.deploy`` artifact at ``prefix`` under ``name``:
         params go device-resident now; bucket programs compile now if the
         server is already started (else at :meth:`start`).  Re-registering
@@ -439,8 +440,27 @@ class Server:
         cache included) and the model is flagged ``quantized`` in
         :meth:`stats` and every per-dispatch JSONL record.  The flag must
         match the artifact — a v3 artifact without it (or an fp32
-        artifact with it) raises, so int8 numerics are always explicit."""
+        artifact with it) raises, so int8 numerics are always explicit.
+
+        ``generate=True`` registers a GENERATION (deploy format v4)
+        artifact written by ``deploy.export_generation``: instead of
+        joining the one-shot batcher, the model gets its own
+        :class:`~mxnet_tpu.generation.GenerationEngine` — a per-iteration
+        continuous-batching scheduler over a paged device-resident KV
+        cache (``serving.kv_pages`` x ``serving.kv_page_size`` tokens,
+        ``serving.decode_slots`` concurrent sequences).  Drive it with
+        :meth:`submit_generate` / :meth:`generate`; plain :meth:`submit`
+        refuses it.  Generation models sit outside the one-shot LRU
+        table (an engine holds live sequences — evicting it mid-flight
+        would kill them) and are removed by :meth:`unregister`."""
         from . import deploy as _deploy
+        if generate:
+            if quantized:
+                raise ServingError(
+                    "model %r: generate=True with quantized=True is not "
+                    "supported — v4 generation artifacts are fp-typed"
+                    % (name,))
+            return self._register_generation(name, prefix)
         predictor = _deploy.StableHLOPredictor(prefix, quantized=quantized)
         if predictor._params is None:
             raise ServingError(
@@ -474,25 +494,75 @@ class Server:
             self._compile_entry(entry)
         return entry
 
+    def _register_generation(self, name, prefix):
+        from . import deploy as _deploy
+        from .generation import GenerationEngine
+        predictor = _deploy.load_generator(prefix)
+        if predictor._params is None:
+            raise ServingError(
+                "model %r: artifact %r was exported with "
+                "include_params=False; serving needs shipped params"
+                % (name, prefix))
+        engine = GenerationEngine(
+            name, predictor,
+            breaker=_Breaker(name, self.breaker_threshold,
+                             self.breaker_cooldown_ms * 1e-3),
+            max_pending=self.max_pending,
+            default_deadline_ms=self.default_deadline_ms)
+        with self._cond:
+            old = self._generation.pop(name, None)
+            self._generation[name] = engine
+            started = self._started
+        if old is not None:
+            old.stop(drain=False)
+        if started:
+            engine.start()
+        return engine
+
     def unregister(self, name):
         with self._cond:
             self._models.pop(name, None)
+            engine = self._generation.pop(name, None)
+        if engine is not None:
+            engine.stop(drain=False)
 
     def models(self):
-        """Registered model names, least recently used first."""
+        """Registered model names, least recently used first (one-shot
+        models; generation models follow)."""
         with self._cond:
-            return list(self._models)
+            return list(self._models) + list(self._generation)
 
     def _entry(self, name):
         with self._cond:
             entry = self._models.get(name)
             if entry is not None:
                 self._models.move_to_end(name)  # LRU touch
+            is_generation = entry is None and name in self._generation
+        if is_generation:
+            raise ServingError(
+                "model %r is a GENERATION model (registered with "
+                "generate=True): it serves token streams, not one-shot "
+                "predicts — use submit_generate()/generate()" % (name,))
         if entry is None:
             raise ServingError(
                 "unknown model %r (registered: %s — evicted models must "
                 "be register()ed again)" % (name, self.models()))
         return entry
+
+    def _engine(self, name):
+        with self._cond:
+            engine = self._generation.get(name)
+            is_oneshot = engine is None and name in self._models
+        if is_oneshot:
+            raise ServingError(
+                "model %r is a one-shot predict model: register it with "
+                "generate=True (a deploy.export_generation artifact) to "
+                "generate — use submit()/predict() for it" % (name,))
+        if engine is None:
+            raise ServingError(
+                "unknown generation model %r (registered: %s)"
+                % (name, self.models()))
+        return engine
 
     # ----------------------------------------------------------- compile
     def _compile_entry(self, entry):
@@ -570,8 +640,11 @@ class Server:
         _configure_compile_cache()
         with self._cond:
             entries = list(self._models.values())
+            engines = list(self._generation.values())
         for entry in entries:
             self._compile_entry(entry)
+        for engine in engines:
+            engine.start()
         # lifecycle flags flip under _cond: _enqueue and the batcher read
         # them under the same lock, so a submit racing start() sees either
         # the fully-started server or the stopped one — never a torn state
@@ -623,6 +696,10 @@ class Server:
                     timeout_s)
         from . import tracing as _tracing
         _tracing.unregister_stall_probe(self._probe_name)
+        with self._cond:
+            engines = list(self._generation.values())
+        for engine in engines:
+            engine.stop(drain=drain, timeout_s=timeout_s)
         with self._cond:
             self._started = False
             self._thread = None
@@ -805,6 +882,42 @@ class Server:
                 "predict(%r) timed out after %.3fs (%d queued chunk(s) "
                 "cancelled undispatched)"
                 % (name, timeout, len(cancelled))) from None
+
+    # -------------------------------------------------------- generation
+    def submit_generate(self, name, prompt, max_new_tokens, eos_id=None,
+                        deadline_ms=None):
+        """Enqueue one prompt on generation model ``name``; returns a
+        Future resolving to the generated token ids (np.int32, EOS
+        included when hit) — bitwise the eager ``greedy_decode`` stream
+        regardless of co-scheduled traffic.
+
+        The request joins the model's per-iteration scheduler: it
+        prefills into a free decode slot as soon as the KV page pool
+        covers ``prompt + max_new_tokens``, decodes alongside whatever
+        else is in flight and exits mid-flight on EOS/budget.  The PR-7
+        admission semantics apply: sheds past ``serving.max_pending``
+        (:class:`ServerOverloadedError`), ``deadline_ms`` bounds QUEUE
+        time (:class:`DeadlineExceededError`, never prefilled), an open
+        breaker fails fast (:class:`CircuitOpenError`)."""
+        from . import tracing as _tracing
+        with _tracing.span("serving.submit", cat="serving", model=name):
+            return self._engine(name).submit(
+                prompt, max_new_tokens, eos_id=eos_id,
+                deadline_ms=deadline_ms)
+
+    def generate(self, name, prompt, max_new_tokens, eos_id=None,
+                 timeout=None, deadline_ms=None):
+        """Synchronous convenience:
+        ``submit_generate(...).result(timeout)``."""
+        fut = self.submit_generate(name, prompt, max_new_tokens,
+                                   eos_id=eos_id, deadline_ms=deadline_ms)
+        try:
+            return fut.result(timeout)
+        except _FutureTimeout:
+            raise DeadlineExceededError(
+                "generate(%r) timed out after %.3fs (the sequence keeps "
+                "decoding; resubmit with deadline_ms to bound queue "
+                "time)" % (name, timeout)) from None
 
     def _count_deadline_exceeded(self, model):
         _telemetry.counter("serving.deadline_exceeded").inc()
@@ -1107,9 +1220,12 @@ class Server:
                              for name, e in self._models.items()}
             pending = len(self._pending)
             thread = self._thread
+            engines = dict(self._generation)
+        generation = {name: eng.stats() for name, eng in engines.items()}
         return {
             "counters": {k: v for k, v in snap["counters"].items()
                          if k.startswith("serving.")},
+            "generation": generation,
             "gauges": {k: v for k, v in snap["gauges"].items()
                        if k.startswith("serving.")},
             "timers": {k: v for k, v in snap["timers"].items()

@@ -222,3 +222,86 @@ def test_predict_validates_shape_and_dtype(tmp_path):
     # fixed-batch artifact also pins the batch dim
     with pytest.raises(ValueError, match="signature"):
         pred.predict(np.zeros((3, 3, 8, 8), np.float32))
+
+
+# ------------------------------------------------- format v4 compat gates
+
+@pytest.fixture(scope="module")
+def gen_artifact(tmp_path_factory):
+    """Smallest v4 generation artifact (1-layer LM, numpy params),
+    exported once for every v4 gate test (tier-1 budget is tight)."""
+    return _export_tiny_generation(tmp_path_factory.mktemp("v4"))
+
+
+def _export_tiny_generation(tmp_path):
+    import jax.numpy as jnp
+    from mxnet_tpu.models.transformer import (TransformerLM,
+                                              TransformerLMConfig)
+    cfg = TransformerLMConfig(vocab_size=17, num_layers=1, d_model=8,
+                              num_heads=1, d_ff=16, max_len=8,
+                              dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    prng = np.random.RandomState(2)
+
+    def mk(*shape):
+        return jnp.asarray(prng.randn(*shape).astype(np.float32) * 0.02)
+
+    params = {
+        "embed": mk(17, 8), "pos_embed": mk(8, 8),
+        "final_norm": jnp.ones((8,), jnp.float32),
+        "layers": {
+            "ln1": jnp.ones((1, 8), jnp.float32),
+            "wqkv": mk(1, 8, 3, 1, 8), "wo": mk(1, 1, 8, 8),
+            "ln2": jnp.ones((1, 8), jnp.float32),
+            "w1": mk(1, 8, 16), "w2": mk(1, 16, 8),
+        },
+    }
+    prefix = str(tmp_path / "gen")
+    deploy.export_generation(model, params, prefix, page_size=4,
+                             max_context=8, prompt_buckets=(4, 8))
+    return prefix
+
+
+def test_generation_artifact_refuses_one_shot_load(gen_artifact):
+    """A v4 generation artifact must never load through load_model —
+    it has prefill/decode program families, no one-shot program (the
+    v4 half of the S4 gate contract)."""
+    prefix = gen_artifact
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    assert meta["format_version"] == 4 and meta["generate"] is True
+    assert meta["kv"]["page_size"] == 4
+    assert meta["prompt_buckets"] == [4, 8]
+    with pytest.raises(ValueError, match="GENERATION.*load_generator"):
+        deploy.load_model(prefix)
+    # the generation loader accepts it and exposes the program families
+    pred = deploy.load_generator(prefix)
+    assert pred.format_version == 4
+    assert pred.prompt_buckets == (4, 8)
+    assert pred.decode_widths[-1] == 2  # ceil(max_context/page_size)
+
+
+def test_one_shot_artifact_refuses_generator_load(tmp_path):
+    """v1-v3 one-shot artifacts keep loading via load_model unchanged,
+    and load_generator rejects them with a typed pointer back."""
+    prefix, x = _export_small(tmp_path)
+    with pytest.raises(ValueError, match="one-shot predict export"):
+        deploy.load_generator(prefix)
+    pred = deploy.load_model(prefix)  # backward-compat half
+    assert pred.format_version == 2
+    assert pred.predict(x).shape == (2, 4)
+
+
+def test_future_format_rejected_by_generator(gen_artifact):
+    """Runs LAST among the v4 gates: it rewrites the shared artifact's
+    meta in place (nothing after it reloads the artifact)."""
+    prefix = gen_artifact
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    meta["format_version"] = deploy.MAX_SUPPORTED_FORMAT + 1
+    with open(prefix + "-meta.json", "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="newer than this build"):
+        deploy.load_generator(prefix)
+    with pytest.raises(ValueError, match="newer than this build"):
+        deploy.load_model(prefix)

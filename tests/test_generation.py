@@ -1,0 +1,175 @@
+"""mx.serving.generate: token-level continuous batching over a paged KV
+cache — offline GenerationPredictor parity vs the eager greedy oracle,
+engine admission validation, KV knob validation, telemetry-report
+generation table + kv_pool_exhaustion anomaly, and the
+tools/check_generation.py smoke (bitwise streams under mid-flight
+exits/joins + flat compiles + pool exhaustion) as a subprocess.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, deploy, generation, serving, telemetry  # noqa: F401
+from mxnet_tpu.models.transformer import TransformerLM, TransformerLMConfig
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import telemetry_report  # noqa: E402
+
+VOCAB, PAGE, CTX = 61, 4, 16
+
+
+def _tiny_lm():
+    """Tiny LM with host-built numpy params (model.init would burn ~1s
+    compiling jax.random for no test value)."""
+    import jax.numpy as jnp
+    cfg = TransformerLMConfig(
+        vocab_size=VOCAB, num_layers=2, d_model=16, num_heads=2,
+        d_ff=32, max_len=CTX, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    prng = np.random.default_rng(5)
+    L, D, F = 2, cfg.d_model, cfg.d_ff
+    H, Dh = cfg.num_heads, cfg.head_dim
+
+    def mk(*shape):
+        return jnp.asarray(
+            prng.normal(0.0, 0.02, size=shape).astype(np.float32))
+
+    params = {
+        "embed": mk(VOCAB, D),
+        "pos_embed": mk(CTX, D) * 25.0,  # position-dependent streams
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "layers": {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "wqkv": mk(L, D, 3, H, Dh),
+            "wo": mk(L, H, Dh, D),
+            "ln2": jnp.ones((L, D), jnp.float32),
+            "w1": mk(L, D, F),
+            "w2": mk(L, F, D),
+        },
+    }
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """One v4 generation artifact + its source model, shared module-wide."""
+    prefix = str(tmp_path_factory.mktemp("generation") / "lm")
+    model, params = _tiny_lm()
+    deploy.export_generation(model, params, prefix, page_size=PAGE,
+                             max_context=CTX, prompt_buckets=(4, 8))
+    return prefix, model, params
+
+
+def test_offline_generate_bitwise_matches_eager_oracle(artifact):
+    """GenerationPredictor.generate (paged-cache prefill + single-token
+    decode steps) reproduces the no-cache eager greedy stream bitwise."""
+    prefix, model, params = artifact
+    pred = deploy.load_generator(prefix)
+    assert pred.format_version == 4
+    rng = np.random.default_rng(3)
+    for plen, max_new in ((3, 5), (7, 9), (4, 4)):
+        prompt = rng.integers(0, VOCAB, size=plen).astype(np.int32)
+        got = pred.generate(prompt, max_new)
+        want = model.greedy_decode(params, prompt, max_new)
+        assert np.array_equal(got, want), (plen, max_new)
+
+
+def test_engine_submit_validation(artifact):
+    prefix, _, _ = artifact
+    pred = deploy.load_generator(prefix)
+    eng = generation.GenerationEngine("m", pred, num_pages=8)
+    ok = np.arange(3, dtype=np.int32)
+    with pytest.raises(ValueError, match="non-empty prompt"):
+        eng.submit(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ValueError, match="non-empty prompt"):
+        eng.submit(ok, 0)
+    with pytest.raises(ValueError, match="max_context"):
+        eng.submit(ok, CTX)  # 3 + 16 > 16
+    with pytest.raises(ValueError, match="largest exported"):
+        eng.submit(np.arange(9, dtype=np.int32), 2)  # buckets cap at 8
+    # a pool too small for the single request, typed before queueing
+    tiny = generation.GenerationEngine("m", pred, num_pages=1)
+    with pytest.raises(ValueError, match="serving.kv_pages"):
+        tiny.submit(ok, 9)  # needs 3 pages, pool holds 1
+    # not started yet: typed ServingError, never a hang
+    with pytest.raises(serving.ServingError, match="not started"):
+        eng.submit(ok, 4)
+
+
+def test_kv_knobs_registered_and_validated():
+    for knob, default in (("serving.kv_page_size", 16),
+                          ("serving.kv_pages", 256),
+                          ("serving.decode_slots", 8)):
+        assert knob in config.knobs()
+        with pytest.raises(ValueError, match="positive integer"):
+            config.set(knob, 0)
+        # the failed set never sticks — reads fall back to the default
+        assert config.get(knob) == default
+        config.set(knob, default + 1)
+        assert config.get(knob) == default + 1
+        config.set(knob, default)  # restore (no unset API)
+
+
+# ------------------------------------------------ telemetry report table
+
+def _gen_rec(model="g", ttft=4.0, wall=40.0, new=8, waited=False):
+    return {"event": "serving_generate", "model": model, "prompt_len": 5,
+            "new_tokens": new, "max_new": new, "pages": 3,
+            "ttft_ms": ttft, "wall_ms": wall,
+            "pool_exhausted_wait": waited, "breaker": "closed"}
+
+
+def test_report_generation_table():
+    s = telemetry_report.summarize(
+        [_gen_rec(ttft=1.0 * i) for i in range(12)])
+    t = s["generation"]["g"]
+    assert t["requests"] == 12 and t["tokens"] == 96
+    assert t["prompt_tokens"] == 60
+    # 96 tokens over 12 * 40ms of per-request wall time
+    assert t["tokens_per_s"] == 200.0
+    assert t["ttft_ms_p50"] is not None and t["pool_waits"] == 0
+    assert s["other_events"] == 0 and s["anomalies"] == []
+
+
+def test_report_kv_pool_exhaustion_anomaly():
+    recs = [_gen_rec(waited=(i % 2 == 0)) for i in range(12)]
+    s = telemetry_report.summarize(recs)
+    assert "kv_pool_exhaustion" in {a["kind"] for a in s["anomalies"]}
+    # waits under the ratio floor (or too few requests) never flag
+    ok = telemetry_report.summarize(
+        [_gen_rec(waited=(i == 0)) for i in range(12)])
+    assert ok["anomalies"] == []
+    few = telemetry_report.summarize([_gen_rec(waited=True)] * 3)
+    assert few["anomalies"] == []
+
+
+def test_report_render_includes_generation(capsys):
+    out = telemetry_report.render(telemetry_report.summarize(
+        [_gen_rec() for _ in range(3)]))
+    assert "tokens/s" in out and "ttft_p50ms" in out
+
+
+# ------------------------------------------------------- smoke wrapper
+
+def test_check_generation_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "tools", "check_generation.py")],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"], report
+    assert report["bitwise"]["mismatches"] == 0
+    assert report["compiles"]["compiled"] == \
+        len(report["compiles"]["prompt_buckets"]) + \
+        len(report["compiles"]["decode_widths"])
+    assert report["kv_pool"]["exhausted_waits"] > 0
+    assert report["elapsed_s"] < 5.0, report

@@ -1,0 +1,190 @@
+"""Fast CPU smoke for mx.serving generation (< 5s).
+
+Proves the token-level continuous-batching path end-to-end on the host
+backend, with one parseable JSON line on stdout:
+
+  1. bitwise — mixed prompt lengths and token budgets submitted
+               concurrently, so sequences EXIT mid-flight (short budgets
+               finish while long ones keep decoding) and queued prefills
+               JOIN the running batch; every returned token stream is
+               BITWISE equal to the eager greedy-decode oracle
+               (``TransformerLM.greedy_decode`` — no cache, full
+               re-forward per token);
+  2. compiles — ``serving.compiles`` after ``start()`` equals the
+               program-family size (prefill buckets + decode widths) and
+               stays FLAT across the ragged traffic;
+  3. exhaustion — a tiny page pool forces head-of-line waits: the
+               ``serving.kv_pool_exhausted`` counter moves, yet every
+               request still completes bitwise;
+  4. gates   — plain ``load_model``/``submit`` refuse the v4 generation
+               artifact/model with typed errors.
+
+Usage: JAX_PLATFORMS=cpu python tools/check_generation.py
+Wired as a `not slow` test in tests/test_generation.py.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+VOCAB = 89
+PAGE_SIZE = 8
+MAX_CONTEXT = 16
+#: (prompt_len, max_new) mix: ragged lengths across two prefill buckets,
+#: budgets that finish at different iterations (mid-flight exits/joins)
+TRAFFIC = ((3, 6), (7, 2), (4, 9), (8, 4), (2, 11), (6, 7))
+PROMPT_BUCKETS = (4, 8)
+
+
+def main():
+    t_main = time.perf_counter()
+    import numpy as np
+    result = {"ok": False}
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_generation_")
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import mxnet_tpu as mx
+        from mxnet_tpu import telemetry
+        from mxnet_tpu.models.transformer import (TransformerLM,
+                                                  TransformerLMConfig)
+        result["backend"] = jax.default_backend()
+
+        cfg = TransformerLMConfig(
+            vocab_size=VOCAB, num_layers=2, d_model=16, num_heads=2,
+            d_ff=32, max_len=MAX_CONTEXT, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        # host-side param init (model.init burns ~1s of the 5s budget
+        # compiling jax.random); pos_embed amplified so greedy streams
+        # vary with position (a fixed-point stream would be a vacuous
+        # parity check)
+        prng = np.random.default_rng(0)
+        L, D, F, V = 2, cfg.d_model, cfg.d_ff, VOCAB
+        H, Dh = cfg.num_heads, cfg.head_dim
+
+        def mk(*shape):
+            return jnp.asarray(
+                prng.normal(0.0, 0.02, size=shape).astype(np.float32))
+
+        params = {
+            "embed": mk(V, D),
+            "pos_embed": mk(MAX_CONTEXT, D) * 25.0,
+            "final_norm": jnp.ones((D,), jnp.float32),
+            "layers": {
+                "ln1": jnp.ones((L, D), jnp.float32),
+                "wqkv": mk(L, D, 3, H, Dh),
+                "wo": mk(L, H, Dh, D),
+                "ln2": jnp.ones((L, D), jnp.float32),
+                "w1": mk(L, D, F),
+                "w2": mk(L, F, D),
+            },
+        }
+
+        prefix = os.path.join(tmpdir, "lm")
+        mx.deploy.export_generation(model, params, prefix,
+                                    page_size=PAGE_SIZE,
+                                    max_context=MAX_CONTEXT,
+                                    prompt_buckets=PROMPT_BUCKETS)
+
+        # 4: the v4 artifact refuses the one-shot load path, typed
+        try:
+            mx.deploy.load_model(prefix)
+            raise AssertionError("load_model accepted a v4 artifact")
+        except ValueError:
+            pass
+
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, VOCAB, size=p).astype(np.int32)
+                   for p, _ in TRAFFIC]
+
+        # tiny pool: covers only ~2 in-flight requests while 4 decode
+        # slots are free, so the 6-request burst must head-of-line wait
+        # on PAGES (not slots) and recycle pages mid-run
+        pool_pages = 2 * math.ceil(
+            (max(p + n for p, n in TRAFFIC)) / PAGE_SIZE)
+        srv = mx.serving.Server()
+        mx.config.set("serving.kv_pages", pool_pages)
+        mx.config.set("serving.decode_slots", 4)
+        engine = srv.register("lm", prefix, generate=True)
+
+        compiles0 = telemetry.counter("serving.compiles").value
+        srv.start()
+        family = (len(engine.predictor.prompt_buckets)
+                  + len(engine.predictor.decode_widths))
+        compiled = telemetry.counter("serving.compiles").value - compiles0
+        assert compiled == family, \
+            "start() compiled %d programs for a family of %d" \
+            % (compiled, family)
+
+        # 4: submit() refuses the generation model, typed
+        try:
+            srv.submit("lm", np.zeros((1, 4), np.int32))
+            raise AssertionError("submit() accepted a generation model")
+        except mx.serving.ServingError:
+            pass
+
+        # 1+3: burst the whole mix at once — queued prefills JOIN the
+        # running decode batch, short budgets EXIT mid-flight while long
+        # ones keep decoding, and the tiny pool forces page waits
+        oracle = [model.greedy_decode(params, pr, n)
+                  for pr, (_, n) in zip(prompts, TRAFFIC)]
+        futs = [srv.submit_generate("lm", pr, n)
+                for pr, (_, n) in zip(prompts, TRAFFIC)]
+        streams = [f.result(timeout=30) for f in futs]
+        mismatch = sum(0 if np.array_equal(s, o) else 1
+                       for s, o in zip(streams, oracle))
+        assert mismatch == 0, \
+            "%d generated stream(s) diverged from the eager oracle" \
+            % mismatch
+
+        traffic_compiles = telemetry.counter("serving.compiles").value \
+            - compiles0
+        assert traffic_compiles == family, \
+            "ragged generation traffic caused %d extra compile(s)" \
+            % (traffic_compiles - family)
+        exhausted = telemetry.counter("serving.kv_pool_exhausted").value
+        assert exhausted > 0, \
+            "tiny pool (%d pages) never hit kv_pool_exhausted" % pool_pages
+        with engine._cond:
+            free = len(engine._free)
+        assert free == pool_pages, \
+            "finished sequences leaked pages: %d/%d free" % (free,
+                                                             pool_pages)
+
+        result["bitwise"] = {
+            "requests": len(TRAFFIC), "mismatches": mismatch,
+            "tokens": int(sum(len(s) for s in streams))}
+        result["compiles"] = {
+            "prompt_buckets": list(engine.predictor.prompt_buckets),
+            "decode_widths": list(engine.predictor.decode_widths),
+            "compiled": traffic_compiles}
+        result["kv_pool"] = {"pages": pool_pages,
+                             "exhausted_waits": int(exhausted)}
+        result["tokens_generated"] = int(
+            telemetry.counter("serving.tokens_generated").value)
+
+        srv.stop()
+        ttft = telemetry.timer("serving.ttft_ms").stats()
+        result["ttft_ms_p50"] = round(ttft["p50"], 3)
+        result["elapsed_s"] = round(time.perf_counter() - t_main, 3)
+        assert result["elapsed_s"] < 5.0, \
+            "smoke exceeded the 5s budget: %.3fs" % result["elapsed_s"]
+        result["ok"] = True
+    except Exception as exc:  # noqa: BLE001 — the JSON line IS the report
+        result["error"] = "%s: %s" % (type(exc).__name__, exc)
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

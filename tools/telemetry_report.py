@@ -62,6 +62,7 @@ MIN_STEPS_FOR_FLAGS = 10
 QUEUE_DELAY_RATIO = 3.0  # serving p99 queue delay vs the configured budget
 SHED_RATIO = 0.10        # shed / offered load before overload is flagged
 MFU_COLLAPSE = 0.5       # late-window MFU median vs the run's own early one
+POOL_WAIT_RATIO = 0.10   # generation requests that stalled on KV pages
 
 
 def load_records(path):
@@ -189,6 +190,63 @@ def _summarize_serving(serving_recs, anomalies):
     return tables
 
 
+def _summarize_generation(gen_recs, anomalies):
+    """Per-model table over ``serving_generate`` records (one per
+    FINISHED generation request — mx.serving continuous batching),
+    appending the ``kv_pool_exhaustion`` anomaly in place.
+
+    ``tokens_per_s`` is the aggregate decode rate — total generated
+    tokens over total per-request wall time.  Under continuous batching
+    wall times of co-scheduled requests overlap, so this is a
+    conservative per-request rate, not device throughput; it is the
+    number a caller experiences."""
+    by_model = {}
+    for r in gen_recs:
+        by_model.setdefault(r.get("model", "?"), []).append(r)
+    tables = {}
+    for model in sorted(by_model):
+        recs = by_model[model]
+        tokens = sum(int(r.get("new_tokens") or 0) for r in recs)
+        prompt_tokens = sum(int(r.get("prompt_len") or 0) for r in recs)
+        ttfts = sorted(float(r["ttft_ms"]) for r in recs
+                       if isinstance(r.get("ttft_ms"), (int, float)))
+        walls = [float(r["wall_ms"]) for r in recs
+                 if isinstance(r.get("wall_ms"), (int, float))]
+        wall_s = sum(walls) * 1e-3
+        pool_waits = sum(1 for r in recs if r.get("pool_exhausted_wait"))
+        breaker = next((r["breaker"] for r in reversed(recs)
+                        if isinstance(r.get("breaker"), str)), None)
+        ttft_p50 = _pct(ttfts, 50)
+        ttft_p99 = _pct(ttfts, 99)
+        tables[model] = {
+            "requests": len(recs),
+            "tokens": tokens,
+            "prompt_tokens": prompt_tokens,
+            "ttft_ms_p50": round(ttft_p50, 3)
+            if ttft_p50 is not None else None,
+            "ttft_ms_p99": round(ttft_p99, 3)
+            if ttft_p99 is not None else None,
+            "tokens_per_s": round(tokens / wall_s, 1)
+            if wall_s > 0 else None,
+            "pool_waits": pool_waits,
+            "breaker": breaker,
+        }
+        # a healthy pool admits immediately; requests routinely stalling
+        # on page-pool exhaustion mean serving.kv_pages is undersized for
+        # the offered concurrency x context length (TTFT pays for it)
+        if (len(recs) >= MIN_STEPS_FOR_FLAGS and
+                pool_waits / float(len(recs)) > POOL_WAIT_RATIO):
+            anomalies.append({
+                "kind": "kv_pool_exhaustion", "source": model,
+                "detail": "%d of %d generation requests waited on KV "
+                          "page-pool exhaustion (%.1f%% > %.0f%%): raise "
+                          "serving.kv_pages or admit less concurrency"
+                          % (pool_waits, len(recs),
+                             100.0 * pool_waits / len(recs),
+                             100.0 * POOL_WAIT_RATIO)})
+    return tables
+
+
 def summarize(records):
     """Reduce parsed records to {"sources": {name: table}, "serving":
     {model: table}, "anomalies": [...], "monitor_events": int,
@@ -196,8 +254,11 @@ def summarize(records):
     tools/check_telemetry.py's no-anomalies assertion."""
     steps = [r for r in records if r.get("event") == "step"]
     serving_recs = [r for r in records if r.get("event") == "serving"]
+    gen_recs = [r for r in records
+                if r.get("event") == "serving_generate"]
     monitor_events = sum(1 for r in records if r.get("event") == "monitor")
-    other = len(records) - len(steps) - len(serving_recs) - monitor_events
+    other = len(records) - len(steps) - len(serving_recs) \
+        - len(gen_recs) - monitor_events
 
     sources = {}
     anomalies = []
@@ -310,7 +371,9 @@ def summarize(records):
                               % (late, early, MFU_COLLAPSE * 100)})
 
     serving = _summarize_serving(serving_recs, anomalies)
-    return {"sources": sources, "serving": serving, "anomalies": anomalies,
+    generation = _summarize_generation(gen_recs, anomalies)
+    return {"sources": sources, "serving": serving,
+            "generation": generation, "anomalies": anomalies,
             "monitor_events": monitor_events, "other_events": other}
 
 
@@ -364,6 +427,22 @@ def render(summary, bad_lines=0):
                             t.get("shed", 0), t.get("deadline_exceeded", 0),
                             t.get("breaker") or "-",
                             ",".join(str(b) for b in t["buckets"])))
+    generation = summary.get("generation") or {}
+    if generation:
+        lines.append("")
+        ghdr = ("%-10s %9s %8s %11s %11s %11s %10s %10s %9s"
+                % ("model", "requests", "tokens", "prompt_tok",
+                   "ttft_p50ms", "ttft_p99ms", "tokens/s", "pool_wait",
+                   "breaker"))
+        lines.append(ghdr)
+        lines.append("-" * len(ghdr))
+        for model, t in generation.items():
+            lines.append("%-10s %9d %8d %11d %11s %11s %10s %10d %9s"
+                         % (model, t["requests"], t["tokens"],
+                            t["prompt_tokens"], _fmt(t["ttft_ms_p50"]),
+                            _fmt(t["ttft_ms_p99"]),
+                            _fmt(t["tokens_per_s"]), t["pool_waits"],
+                            t.get("breaker") or "-"))
     if summary["monitor_events"]:
         lines.append("monitor events: %d" % summary["monitor_events"])
     if summary["other_events"]:
