@@ -1,6 +1,6 @@
 """mx.analysis — the framework-native static-analysis suite.
 
-Three AST-level pass families guard the invariants this codebase keeps
+Six AST-level pass families guard the invariants this codebase keeps
 re-learning by hand (docs/ANALYSIS.md):
 
 * ``jit`` (jit_purity.py) — host syncs, tracer branches, trace-time
@@ -10,6 +10,15 @@ re-learning by hand (docs/ANALYSIS.md):
   background thread.
 * ``drift`` (drift.py) — knob registry, env-var docs and telemetry
   metric index kept honest in both directions.
+* ``shard`` (shard_spec.py) — shard_map/PartitionSpec/collective axis
+  names checked against the mesh-axis registry, in_specs arity vs the
+  wrapped signature, and replicated embedding-table specs.
+* ``cache`` (compile_cache.py) — the "compiles stay flat" invariant:
+  per-call values and config reads must not reach a cached traced
+  program without being part of its cache key.
+* ``seam`` (step_seam.py) — fused-step machinery (donation, nanguard
+  folding, pad-masking, step_scope) outside runtime.py/symbol.py's
+  sanctioned core; the baseline burn-down for ROADMAP item 3.
 
 ``run(root)`` executes every pass over a parsed ``walker.Repo``,
 applies inline ``# mxlint: disable=`` comments and the checked-in
@@ -21,18 +30,29 @@ second.
 """
 from __future__ import annotations
 
-from . import drift, jit_purity, lock_discipline, walker
+from . import compile_cache, drift, jit_purity, lock_discipline, \
+    shard_spec, step_seam, walker
 from .walker import Baseline, Finding, Repo
 
 __all__ = ["run", "Report", "Repo", "Finding", "Baseline", "PASSES",
-           "walker", "jit_purity", "lock_discipline", "drift"]
+           "WHOLE_TREE_RULES", "walker", "jit_purity", "lock_discipline",
+           "drift", "shard_spec", "compile_cache", "step_seam"]
 
 #: pass id -> module; order is the report order.
 PASSES = {
     "jit": jit_purity,
     "locks": lock_discipline,
     "drift": drift,
+    "shard": shard_spec,
+    "cache": compile_cache,
+    "seam": step_seam,
 }
+
+#: rules whose verdict needs the WHOLE tree parsed (an unused knob is
+#: only dead if *no* file reads it) — meaningless under --changed-only.
+WHOLE_TREE_RULES = frozenset({
+    "dead-knob", "dead-metric", "stale-doc", "missing-index",
+})
 
 
 class Report(object):
@@ -68,11 +88,13 @@ class Report(object):
         }
 
 
-def run(root, passes=None, baseline=None, targets=walker.DEFAULT_TARGETS):
+def run(root, passes=None, baseline=None, targets=walker.DEFAULT_TARGETS,
+        today=None):
     """Run the suite over the tree at ``root``.
 
     ``passes``: iterable of pass ids (default: all).  ``baseline``: a
-    ``walker.Baseline``, a path to one, or None.
+    ``walker.Baseline``, a path to one, or None.  ``today``: "YYYY-MM"
+    override for baseline expiry checks (tests; default: wall clock).
     """
     repo = Repo(root, targets=targets)
     findings = []
@@ -92,5 +114,6 @@ def run(root, passes=None, baseline=None, targets=walker.DEFAULT_TARGETS):
     # baseline suppressions
     if isinstance(baseline, str):
         baseline = Baseline.load(baseline)
-    expired = baseline.apply(findings) if baseline is not None else []
+    expired = baseline.apply(findings, today=today) \
+        if baseline is not None else []
     return Report(findings, expired, repo)

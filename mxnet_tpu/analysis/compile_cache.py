@@ -1,0 +1,466 @@
+"""compile-cache-key pass (pass id: ``cache``).
+
+The "compiles stay flat" invariant: every jit/AOT program the framework
+builds is stored in a program cache (the five ``perf.py`` families —
+module/spmd/gluon/serving/embedding), and anything that changes the
+traced computation must be part of that cache's key, or the cache
+serves a stale program.  PR 11 made retraces *visible* (MFU accounting
+attributes every compile); this pass makes the class of bug a lint.
+
+Rules:
+
+* ``uncached-jit``   — ``jax.jit(fn)(args)`` invoked immediately: a
+  fresh program is traced on every call, the cache is bypassed
+  entirely.  (``jax.jit`` does memoize on the function object, but a
+  fresh lambda/closure per call defeats that too.)  Scoped to the
+  framework tree — ``tools/`` check scripts are one-shot CLIs where an
+  immediate jit dispatch is the point.
+* ``stale-knob-key`` — a config read reaches a cached traced program
+  (directly in the traced body, through a one-hop resolvable helper
+  such as ``parallel.embedding.unique_capacity``, or baked into a
+  closure constant computed in the builder) while the owning
+  class/function never consults ``config.epoch()``.  Flipping the knob
+  then leaves stale programs in the cache.  The sanctioned pattern is
+  epoch keying (symbol.py ``key_sig``, gluon ``_CachedGraph``) or an
+  epoch-checked ``cache.clear()``.
+* ``unkeyed-capture`` — a traced closure captures a builder local
+  derived from a *per-call* value (``.shape`` unpacking, ``len()``,
+  ``int()``/``float()`` coercions of non-parameter state) that is
+  absent from every cache-key expression of the owner: two calls that
+  should hit the same entry can observe different baked-in constants.
+  Values derived from the builder's own parameters are trusted — the
+  caller keys on those (that is what ``_prog(kind, ids_shape)``-style
+  builders are for); ``self`` attributes assigned only in ``__init__``
+  are trusted too.
+
+Both cache rules activate only for owners that actually hold a program
+cache — a subscript store whose value is a ``jax.jit(...)`` /
+``perf.wrap(...)`` program — so one-shot jit users (export paths) stay
+out of scope.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .jit_purity import _collect_scopes, _base_module, _is_jit_callee, \
+    _param_names
+from .walker import Finding, dotted_name
+
+PASS_ID = "cache"
+
+_BUILTINS = frozenset({
+    "len", "int", "float", "bool", "str", "tuple", "list", "dict", "set",
+    "frozenset", "max", "min", "sum", "abs", "round", "sorted", "zip",
+    "enumerate", "range", "map", "filter", "isinstance", "getattr",
+    "hasattr", "id", "repr", "type", "print", "None", "True", "False",
+    "Exception", "ValueError", "TypeError", "KeyError", "RuntimeError",
+})
+
+
+def _is_config_get(module, call):
+    """``config.get("...")`` against the framework config module; returns
+    the knob name (or "") on match, None otherwise."""
+    d = dotted_name(call.func)
+    if not d or d.split(".")[-1] != "get" or "." not in d:
+        return None
+    if _base_module(module, d).split(".")[-1] != "config":
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return ""
+
+
+def _is_epoch_call(module, call):
+    d = dotted_name(call.func)
+    if not d or d.split(".")[-1] != "epoch" or "." not in d:
+        return False
+    return _base_module(module, d).split(".")[-1] == "config"
+
+
+def _scope_assigns(fn):
+    """Name assignments in ``fn``'s own scope (not nested defs),
+    in source order: [(name, value_node, lineno)]."""
+    out = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append((t.id, node.value, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body if isinstance(fn.body, list) else []:
+        visit(stmt)
+    return out
+
+
+def _free_vars(fn):
+    """Names loaded in ``fn`` but bound neither by its params nor by any
+    assignment/def inside it (over-binding nested-def locals is fine —
+    it only shrinks the set)."""
+    bound = set(_param_names(fn))
+    loaded = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            (loaded if isinstance(node.ctx, ast.Load) else bound).add(
+                node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                bound.add(node.name)
+                bound.update(_param_names(node))
+        elif isinstance(node, ast.Lambda):
+            if node is not fn:
+                bound.update(_param_names(node))
+    return loaded - bound - _BUILTINS
+
+
+class CompileCache(object):
+    def __init__(self, repo):
+        self.repo = repo
+        self.findings = []
+        self._reads_config_memo = {}
+        self._emitted = set()
+
+    def emit(self, module, lineno, rule, symbol, detail, message):
+        f = Finding(PASS_ID, rule, module.relpath, lineno, symbol,
+                    detail, message)
+        if f.key in self._emitted:
+            return
+        self._emitted.add(f.key)
+        self.findings.append(f)
+
+    # ------------------------------------------------------ config reach
+    def _callee_reads_config(self, module, d):
+        """Dotted callee resolves to a function whose body reads config
+        (one hop).  Returns the knob name, "" for a non-literal read,
+        or None."""
+        resolved = self.repo.resolve_function(module, d)
+        if resolved is None:
+            return None
+        owner, fn = resolved
+        memo_key = (owner.modname, fn.name)
+        if memo_key in self._reads_config_memo:
+            return self._reads_config_memo[memo_key]
+        knob = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                k = _is_config_get(owner, node)
+                if k is not None:
+                    knob = k
+                    break
+        self._reads_config_memo[memo_key] = knob
+        return knob
+
+    # -------------------------------------------------- owner structure
+    def _method_map(self, owner):
+        if isinstance(owner, ast.ClassDef):
+            return {m.name: m for m in owner.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        return {owner.name: owner}
+
+    def _mutable_attrs(self, owner):
+        """self attributes assigned outside __init__ (per-call state)."""
+        out = set()
+        if not isinstance(owner, ast.ClassDef):
+            return out
+        for name, meth in self._method_map(owner).items():
+            if name == "__init__":
+                continue
+            for node in ast.walk(meth):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.add(t.attr)
+        return out
+
+    def _is_program_expr(self, module, expr, methods):
+        """Does this RHS build a jit/perf-wrapped program?"""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_callee(module, node.func):
+                return True
+            d = dotted_name(node.func)
+            if d and d.split(".")[-1] == "wrap" and "." in d and \
+                    _base_module(module, d).split(".")[-1] == "perf":
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and \
+                    node.func.attr in methods:
+                target = methods[node.func.attr]
+                if any(isinstance(n, ast.Call) and
+                       _is_jit_callee(module, n.func)
+                       for n in ast.walk(target)):
+                    return True
+        return False
+
+    def _store_keys(self, module, owner):
+        """Program-cache stores inside the owner: [(key_expr, lineno)].
+
+        A store is ``<something>[key] = <program expr>`` where the RHS
+        (or the local it names, resolved through a prior assignment in
+        the same method) builds a jit / perf.wrap program."""
+        methods = self._method_map(owner)
+        keys = []
+        for meth in methods.values():
+            assigns = _scope_assigns(meth)
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Assign):
+                    continue
+                subs = [t for t in node.targets
+                        if isinstance(t, ast.Subscript)]
+                if not subs:
+                    continue
+                value = node.value
+                if isinstance(value, ast.Name):
+                    prior = [v for n, v, ln in assigns
+                             if n == value.id and ln < node.lineno]
+                    if prior:
+                        value = prior[-1]
+                if not self._is_program_expr(module, value, methods):
+                    continue
+                for t in subs:
+                    keys.append((t.slice, node.lineno))
+        return keys
+
+    def _epoch_aware(self, module, owner):
+        return any(isinstance(n, ast.Call) and _is_epoch_call(module, n)
+                   for n in ast.walk(owner))
+
+    # ------------------------------------------------------ closure rules
+    def _check_closure(self, module, owner_name, builder, closure,
+                       key_text, mutable_attrs):
+        module_names = set(module.top_funcs) | set(module.classes) | \
+            set(module.import_aliases) | set(module.from_imports) | \
+            {n for n, _, _ in _scope_assigns_module(module)}
+        assigns = _scope_assigns(builder)
+        trusted = set(_param_names(builder))
+        for _ in range(2):
+            for name, value, _ln in assigns:
+                if name not in trusted and self._expr_trusted(
+                        value, trusted, module_names, mutable_attrs):
+                    trusted.add(name)
+        symbol = "%s.%s" % (owner_name, builder.name) \
+            if owner_name and owner_name != builder.name else builder.name
+        free = _free_vars(closure)
+
+        # stale-knob-key: config reads inside the traced body
+        seen = set()
+        for node in ast.walk(closure):
+            if not isinstance(node, ast.Call):
+                continue
+            knob = _is_config_get(module, node)
+            d = dotted_name(node.func)
+            if knob is None and d:
+                hop = self._callee_reads_config(module, d)
+                if hop is not None:
+                    knob = hop or d
+            if knob is not None and knob not in seen:
+                seen.add(knob)
+                self.emit(
+                    module, node.lineno, "stale-knob-key", symbol,
+                    knob or "config",
+                    "traced body reads config (%s) but the owner of the "
+                    "program cache never consults config.epoch() — a "
+                    "knob flip leaves a stale compiled program in the "
+                    "cache (key on config.epoch(), see symbol.py "
+                    "key_sig / gluon._CachedGraph)" % (knob or "get"))
+
+        # stale-knob-key: config-derived closure constants from the
+        # builder scope; unkeyed-capture: per-call derived constants
+        for name, value, lineno in assigns:
+            if name not in free:
+                continue
+            if isinstance(value, ast.Call):
+                d = dotted_name(value.func)
+                knob = _is_config_get(module, value)
+                if knob is None and d:
+                    knob = self._callee_reads_config(module, d)
+                if knob is not None and (knob or d) not in seen:
+                    seen.add(knob or d)
+                    self.emit(
+                        module, lineno, "stale-knob-key", symbol,
+                        knob or d,
+                        "closure constant %r is derived from config "
+                        "(%s) and baked into a cached program whose "
+                        "owner never consults config.epoch() — a knob "
+                        "flip serves stale compiles"
+                        % (name, knob or d))
+                    continue
+            roots = _taboo_roots(value)
+            if not roots:
+                continue
+            bad = [r for r in roots if not self._root_trusted(
+                r, trusted, module_names, mutable_attrs)]
+            if not bad:
+                continue
+            if re.search(r"\b%s\b" % re.escape(name), key_text):
+                continue
+            what = ", ".join(sorted({r[1] for r in bad}))
+            self.emit(
+                module, lineno, "unkeyed-capture", symbol, name,
+                "closure constant %r is derived from per-call state "
+                "(%s) but is not part of the program-cache key — two "
+                "calls hitting the same cache entry can observe "
+                "different baked-in values (add it to the key or derive "
+                "it from the keyed builder arguments)" % (name, what))
+
+    def _root_trusted(self, root, trusted, module_names, mutable_attrs):
+        kind, name = root
+        if kind == "self":
+            return name not in mutable_attrs
+        return name in trusted or name in module_names or \
+            name in _BUILTINS
+
+    def _expr_trusted(self, value, trusted, module_names, mutable_attrs):
+        local = {n.id for n in ast.walk(value)
+                 if isinstance(n, ast.Name)
+                 and not isinstance(n.ctx, ast.Load)}
+        for node in ast.walk(value):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                if node.attr in mutable_attrs:
+                    return False
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                if node.id == "self" or node.id in local:
+                    continue
+                if node.id not in trusted and \
+                        node.id not in module_names and \
+                        node.id not in _BUILTINS:
+                    return False
+        return True
+
+    # ------------------------------------------------------------ owners
+    def _check_owner(self, module, scopes, parents, owner):
+        stores = self._store_keys(module, owner)
+        if not stores:
+            return
+        if self._epoch_aware(module, owner):
+            return
+        owner_name = owner.name
+        key_text = " ".join(ast.unparse(k) for k, _ in stores)
+        mutable_attrs = self._mutable_attrs(owner)
+        for meth in self._method_map(owner).values():
+            for call in ast.walk(meth):
+                if not (isinstance(call, ast.Call) and
+                        _is_jit_callee(module, call.func) and call.args):
+                    continue
+                arg = call.args[0]
+                closure = None
+                if isinstance(arg, ast.Lambda):
+                    closure = arg
+                elif isinstance(arg, ast.Name):
+                    anc = parents.get(call)
+                    while anc is not None and anc not in scopes:
+                        anc = parents.get(anc)
+                    sc = scopes.get(anc, scopes[module.tree])[0]
+                    closure = sc.lookup(arg.id) if sc else None
+                if closure is None:
+                    continue
+                builder = parents.get(call)
+                while builder is not None and not isinstance(
+                        builder, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    builder = parents.get(builder)
+                if builder is None:
+                    continue
+                self._check_closure(module, owner_name, builder, closure,
+                                    key_text, mutable_attrs)
+
+    def run(self):
+        for module in self.repo.modules:
+            if "jit(" not in module.text:
+                continue
+            scopes = self._scopes(module)
+            parents = self._parents(module)
+            in_tools = module.relpath.replace("\\", "/").startswith(
+                "tools/")
+            for node in ast.walk(module.tree):
+                if not in_tools and isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Call) and \
+                        _is_jit_callee(module, node.func.func):
+                    anc = parents.get(node)
+                    while anc is not None and anc not in scopes:
+                        anc = parents.get(anc)
+                    qual = scopes.get(anc, scopes[module.tree])[1]
+                    self.emit(
+                        module, node.lineno, "uncached-jit", qual,
+                        "inline-jit",
+                        "jax.jit(...) invoked immediately — a fresh "
+                        "program is traced per call; build the jitted "
+                        "callable once and store it in a program cache "
+                        "(perf.wrap keys + MFU attribution come free)")
+            for node in module.tree.body:
+                if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._check_owner(module, scopes, parents, node)
+        return self.findings
+
+    def _scopes(self, module):
+        if not hasattr(module, "_mxa_scopes"):
+            module._mxa_scopes = _collect_scopes(module.tree)
+        return module._mxa_scopes
+
+    def _parents(self, module):
+        if not hasattr(module, "_mxa_parents"):
+            parents = {}
+            for node in ast.walk(module.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            module._mxa_parents = parents
+        return module._mxa_parents
+
+
+def _taboo_roots(value):
+    """Roots of per-call derivations (.shape / len() / int() / float())
+    inside an expression: [("name", id) | ("self", attr)]."""
+    roots = []
+    for node in ast.walk(value):
+        expr = None
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            expr = node.value
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("len", "int", "float") and node.args:
+                expr = node.args[0]
+        if expr is None:
+            continue
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                roots.append(("self", sub.attr))
+            elif isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Load) and sub.id != "self":
+                roots.append(("name", sub.id))
+    return roots
+
+
+def _scope_assigns_module(module):
+    """Module-level Name assignments (for the trusted-namespace set)."""
+    out = []
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append((t.id, node.value, node.lineno))
+    return out
+
+
+def run(repo):
+    return CompileCache(repo).run()

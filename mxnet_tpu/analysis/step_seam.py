@@ -1,0 +1,177 @@
+"""fused-step seam inventory pass (pass id: ``seam``).
+
+ROADMAP item 3 wants the fused-step machinery — donation wiring,
+nanguard folding, pad-masking, ``telemetry.step_scope`` bracketing —
+consolidated behind one ``mx.runtime.StepProgram`` instead of the four
+hand-kept copies that grew organically (Module, SPMDTrainer dense +
+sparse, gluon Trainer).  This pass turns that consolidation into a
+baseline burn-down: it inventories every *duplicate* fused-step site
+outside the sanctioned core and emits one finding per site, keyed
+line-insensitively so the checked-in baseline (with an ``expires:``
+date) tracks exactly the known copies.  Extracting a copy into the
+core deletes its finding; its baseline entry then reports as expired
+and must be removed — the ledger can only shrink.
+
+What counts as step machinery (markers):
+
+* ``traced-fold``   — the on-device nanguard fold: ``resilience.
+  all_finite`` / ``guarded_streak`` / ``select_tree`` inside a step
+  builder.  A method containing one of these IS a step-program builder
+  and gets its own finding.
+* ``nanguard-host`` — the host-side halves: ``resilience.watch_streak``
+  / ``note_finite`` / ``report_nonfinite`` / ``nanguard_mode`` /
+  ``maybe_abort_nonfinite``.
+* ``step-scope``    — ``telemetry.step_scope(...)`` bracketing.
+* ``donation``      — ``jax.jit(..., donate_argnums=...)`` wiring.
+* ``pad-mask``      — calls to the ``*masked*`` pad-correction helpers.
+
+Grouping: inside each top-level class, every method containing a
+``traced-fold`` marker yields one finding (symbol ``Class.method``);
+the class's residual host-side markers are folded into those findings'
+messages.  A class (or module-level function) with no traced fold
+needs at least ``_MIN_CLASS_HITS`` markers to count as a duplicate
+seam — one donation kwarg alone (deploy/export paths) is not a step
+program.  ``runtime.py``/``symbol.py`` are the sanctioned core;
+``resilience.py``/``telemetry.py`` own the primitives themselves.
+"""
+from __future__ import annotations
+
+import ast
+
+from .jit_purity import _base_module, _is_jit_callee
+from .walker import Finding, dotted_name
+
+PASS_ID = "seam"
+
+#: relpaths (posix form) allowed to host fused-step machinery: the
+#: sanctioned core plus the modules that *define* the primitives.
+SANCTIONED = frozenset({
+    "mxnet_tpu/runtime.py",
+    "mxnet_tpu/symbol/symbol.py",
+    "mxnet_tpu/resilience.py",
+    "mxnet_tpu/telemetry.py",
+})
+
+_TRACED_FOLD = frozenset({"all_finite", "guarded_streak", "select_tree"})
+_NANGUARD_HOST = frozenset({"watch_streak", "note_finite",
+                            "report_nonfinite", "nanguard_mode",
+                            "maybe_abort_nonfinite"})
+
+#: a class/function with no traced fold is only a seam when it hosts at
+#: least this many step markers (filters lone donate_argnums sites).
+_MIN_CLASS_HITS = 3
+
+_PREFILTER = ("resilience", "step_scope", "donate_argnums", "masked")
+
+
+def _marker_module(module, d, owners):
+    """True when dotted callee ``d`` resolves into a module whose last
+    path component is one of ``owners`` ("resilience"/"telemetry")."""
+    if "." in d:
+        base = _base_module(module, d)
+        return base.split(".")[-1] in owners
+    src = module.from_imports.get(d)
+    return bool(src and src[0].split(".")[-1] in owners)
+
+
+def _categorize(module, call):
+    """Marker category for one Call node, or None."""
+    d = dotted_name(call.func)
+    if d:
+        last = d.split(".")[-1]
+        if last in _TRACED_FOLD and \
+                _marker_module(module, d, ("resilience",)):
+            return "traced-fold"
+        if last in _NANGUARD_HOST and \
+                _marker_module(module, d, ("resilience",)):
+            return "nanguard-host"
+        if last == "step_scope" and \
+                _marker_module(module, d, ("telemetry",)):
+            return "step-scope"
+        if "masked" in last.split(".")[-1]:
+            return "pad-mask"
+    if _is_jit_callee(module, call.func) and \
+            any(kw.arg == "donate_argnums" for kw in call.keywords):
+        return "donation"
+    return None
+
+
+def _hits_in(module, fn):
+    """(category, lineno) markers in one def's subtree."""
+    hits = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            cat = _categorize(module, node)
+            if cat:
+                hits.append((cat, node.lineno))
+    return hits
+
+
+def _summarize(hits):
+    cats = sorted({c for c, _ in hits})
+    return "%s (%d site%s)" % ("/".join(cats), len(hits),
+                               "s" if len(hits) != 1 else "")
+
+
+def _scan_owner(rel, name, per_member, findings):
+    """Emit findings for one top-level class (``per_member`` maps method
+    name -> (first_line, hits)) or module-level function (single entry
+    keyed by its own name)."""
+    builders = [(m, line, hits) for m, (line, hits) in per_member.items()
+                if any(c == "traced-fold" for c, _ in hits)]
+    residual = [h for m, (_, hits) in per_member.items()
+                if not any(c == "traced-fold" for c, _ in hits)
+                for h in hits]
+    if builders:
+        note = ""
+        if residual:
+            note = "; %s also hosts host-side %s" % (name,
+                                                     _summarize(residual))
+        for member, line, hits in sorted(builders, key=lambda b: b[1]):
+            symbol = member if member == name else "%s.%s" % (name, member)
+            fold_line = min(l for c, l in hits if c == "traced-fold")
+            findings.append(Finding(
+                PASS_ID, "duplicate-step", rel, fold_line, symbol, "",
+                "%s builds a fused step program by hand — %s — outside "
+                "the sanctioned core (runtime.py/symbol.py)%s; fold it "
+                "into mx.runtime.StepProgram (ROADMAP item 3)"
+                % (symbol, _summarize(hits), note)))
+        return
+    total = [h for _, (_, hits) in per_member.items() for h in hits]
+    if len(total) >= _MIN_CLASS_HITS:
+        findings.append(Finding(
+            PASS_ID, "duplicate-step", rel, min(l for _, l in total),
+            name, "",
+            "%s duplicates host-side fused-step machinery — %s — "
+            "outside the sanctioned core (runtime.py/symbol.py); fold "
+            "it into mx.runtime.StepProgram (ROADMAP item 3)"
+            % (name, _summarize(total))))
+
+
+def run(repo):
+    findings = []
+    for module in repo.modules:
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mxnet_tpu/"):
+            continue
+        if rel in SANCTIONED or rel.startswith("mxnet_tpu/analysis/"):
+            continue
+        if not any(tok in module.text for tok in _PREFILTER):
+            continue
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                per_member = {}
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        hits = _hits_in(module, meth)
+                        if hits:
+                            per_member[meth.name] = (meth.lineno, hits)
+                if per_member:
+                    _scan_owner(rel, node.name, per_member, findings)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                hits = _hits_in(module, node)
+                if hits:
+                    _scan_owner(rel, node.name,
+                                {node.name: (node.lineno, hits)}, findings)
+    return findings

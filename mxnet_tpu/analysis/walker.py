@@ -41,6 +41,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 
 __all__ = [
@@ -324,7 +325,16 @@ class Baseline(object):
     Applying a baseline marks matching findings suppressed and returns
     synthetic ``baseline.expired`` findings for entries that matched
     nothing — an expired entry fails the lint just like a real finding,
-    so the file stays an honest ledger."""
+    so the file stays an honest ledger.
+
+    An entry may carry ``expires: "YYYY-MM"``: past that month the
+    entry stops suppressing (its findings surface again) and a
+    ``baseline.date-expired`` finding names the overdue entry — the
+    burn-down analog of a TODO with a deadline (the step-seam ledger
+    uses this, docs/ANALYSIS.md).  ``write()`` regenerates the file
+    from a finding set, carrying forward reasons/expiry dates for keys
+    that survive so ``mxlint --baseline-write`` beats hand-editing
+    JSON."""
 
     def __init__(self, entries=None, path=None):
         self.path = path
@@ -338,23 +348,65 @@ class Baseline(object):
             data = json.load(f)
         return cls(data.get("suppressions", []), path=path)
 
-    def apply(self, findings):
+    def _relpath(self):
+        return os.path.relpath(self.path, start=os.getcwd()) \
+            if self.path else "mxlint_baseline.json"
+
+    def apply(self, findings, today=None):
+        if today is None:
+            today = time.strftime("%Y-%m")
         by_key = {}
         for f in findings:
             by_key.setdefault(f.key, []).append(f)
         expired = []
         for entry in self.entries:
-            matched = by_key.get(entry.get("id"), [])
+            eid = entry.get("id", "")
+            matched = by_key.get(eid, [])
             if not matched:
-                rel = os.path.relpath(self.path, start=os.getcwd()) \
-                    if self.path else "mxlint_baseline.json"
-                exp = Finding(
-                    "baseline", "expired", rel, 0, "", entry.get("id", ""),
+                expired.append(Finding(
+                    "baseline", "expired", self._relpath(), 0, "", eid,
                     "baseline entry %r no longer matches any finding — "
-                    "delete it" % entry.get("id", ""))
-                expired.append(exp)
+                    "delete it" % eid))
+                continue
+            expiry = entry.get("expires")
+            if expiry and today > expiry:
+                # overdue: the matched findings stay ACTIVE, and the
+                # stale suppression is called out by name
+                expired.append(Finding(
+                    "baseline", "date-expired", self._relpath(), 0, "",
+                    eid,
+                    "baseline suppression %r expired %s — fix the "
+                    "finding or renew the entry (--baseline-write keeps "
+                    "the reason, the expiry must be re-justified)"
+                    % (eid, expiry)))
                 continue
             for f in matched:
                 f.suppressed = True
                 f.reason = entry.get("reason", "")
         return expired
+
+    _COMMENT = (
+        "mxlint suppression ledger (docs/ANALYSIS.md). Every entry "
+        "carries a one-line justification; entries that stop matching "
+        "a live finding are reported as baseline.expired and FAIL the "
+        "lint, so this file can only shrink or stay honest. Optional "
+        "'expires: YYYY-MM' turns an entry into a burn-down deadline.")
+
+    def write(self, path, findings):
+        """Regenerate the ledger from active findings, keeping each
+        surviving key's reason and expiry.  Returns the entries."""
+        prev = {e.get("id"): e for e in self.entries}
+        entries = []
+        for key in sorted({f.key for f in findings}):
+            entry = {"id": key}
+            old = prev.get(key, {})
+            entry["reason"] = old.get(
+                "reason", "FIXME: justify this suppression")
+            if "expires" in old:
+                entry["expires"] = old["expires"]
+            entries.append(entry)
+        with open(path, "w") as f:
+            json.dump({"_comment": self._COMMENT,
+                       "suppressions": entries}, f, indent=2)
+            f.write("\n")
+        return entries

@@ -289,13 +289,22 @@ class ShardedEmbedding:
         self.state = None if st is None else jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sh), st)
         self._t = 0
-        self._progs = {}  # (kind, ids_shape) -> jitted program
+        self._progs = {}  # (kind, ids_shape, config-epoch) -> program
 
     # ------------------------------------------------------------ programs
     def _prog(self, kind, ids_shape):
-        prog = self._progs.get((kind, ids_shape))
+        from .. import config as _config
+        # the programs bake in config-derived constants (unique_capacity
+        # reads embedding.unique_size), so the config epoch is part of
+        # the key and superseded entries are evicted — the same
+        # invalidation contract as symbol.py's key_sig
+        epoch = _config.epoch()
+        key = (kind, ids_shape, epoch)
+        prog = self._progs.get(key)
         if prog is not None:
             return prog
+        self._progs = {k: v for k, v in self._progs.items()
+                       if k[-1] == epoch}
         from .. import telemetry as _telemetry
         _telemetry.counter("embedding.lookup_compiles").inc()
         cap = unique_capacity(max(_math.prod(ids_shape), 1))
@@ -326,7 +335,7 @@ class ShardedEmbedding:
         # the owning trainer's fused program
         prog = _perf.wrap(prog, "embedding",
                           "%s/%s" % (kind, ids_shape))
-        self._progs[(kind, ids_shape)] = prog
+        self._progs[key] = prog
         return prog
 
     # -------------------------------------------------------------- public

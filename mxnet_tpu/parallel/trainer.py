@@ -582,10 +582,18 @@ class SPMDTrainer:
         if self.params is None:
             self._materialize(data)
         guard = _resilience.nanguard_mode()
+        from .. import config as _config
         from .. import kernels as _kernels
         kmode = _kernels.enabled()
+        # the traced step bodies bake in config-derived constants beyond
+        # the guard/kernels knobs (the sparse path sizes its dedup
+        # buffers from embedding.unique_size), so any config mutation —
+        # tracked by the epoch counter — invalidates the program cache
+        epoch = _config.epoch()
         if self._jitted and (guard != self._guard_mode or
-                             kmode != getattr(self, "_kernel_mode", kmode)):
+                             kmode != getattr(self, "_kernel_mode", kmode)
+                             or epoch != getattr(self, "_config_epoch",
+                                                 epoch)):
             self._jitted.clear()  # knob flip: rebuild with/without the guard
         # the program cache is keyed by pad count: the pad-masked loss uses
         # a STATIC slice so its reduction is structurally identical to the
@@ -595,6 +603,7 @@ class SPMDTrainer:
         if jitted is None:
             self._guard_mode = guard
             self._kernel_mode = kmode
+            self._config_epoch = epoch
             from .. import perf as _perf
             # kernels=on earns its own program key; the OFF key is
             # unchanged from earlier rounds so perf artifacts stay

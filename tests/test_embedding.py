@@ -176,6 +176,30 @@ def test_sharded_embedding_compile_cache_and_telemetry():
         pytest.approx(1.0 / (B * 3))
 
 
+def test_sharded_embedding_config_epoch_invalidates_programs():
+    """embedding.unique_size is baked into the lookup program at trace
+    time (it sizes the dedup buffer): flipping the knob must rebuild the
+    program, not serve the stale compile.  The cache is keyed by
+    config.epoch() — the cache.stale-knob-key contract the mxlint
+    compile-cache pass enforces (docs/ANALYSIS.md pass family 5)."""
+    mesh = _mesh(2)
+    emb = ShardedEmbedding(VOCAB, DIM, mesh=mesh, optimizer="sgd")
+    # few unique ids, so a capped dedup buffer still holds all of them
+    ids = np.random.RandomState(1).choice(
+        [3, 5, 7], size=(B, 3)).astype(np.int32)
+    out0 = np.asarray(emb.lookup(ids))
+    config.set("embedding.unique_size", 8)
+    try:
+        out1 = np.asarray(emb.lookup(ids))
+        # same shape hit the cache, but the epoch moved: every surviving
+        # entry is keyed by the NEW epoch (old-epoch programs evicted)
+        assert emb._progs, "program cache unexpectedly empty"
+        assert all(k[-1] == config.epoch() for k in emb._progs)
+        np.testing.assert_array_equal(out0, out1)
+    finally:
+        config.set("embedding.unique_size", 0)
+
+
 def test_unique_size_knob_caps_capacity_and_rejects_negative():
     from mxnet_tpu.parallel.embedding import unique_capacity
     assert unique_capacity(24) == 24

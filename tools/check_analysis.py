@@ -1,18 +1,22 @@
-"""Fast CPU smoke for the mx.analysis static-analysis suite (< 5s).
+"""Fast CPU smoke for the mx.analysis static-analysis suite (~3s).
 
-Proves the three mxlint pass families end-to-end, with one parseable
+Proves the six mxlint pass families end-to-end, with one parseable
 JSON line on stdout:
 
   1. clean   — ``python tools/mxlint.py`` run as a subprocess over THIS
                tree exits 0 against the checked-in baseline
                (tools/mxlint_baseline.json): the codebase carries no
-               unsuppressed jit-purity, lock-discipline or drift
-               finding, and every baseline entry still matches (an
-               expired entry would fail this step);
+               unsuppressed finding from any pass family, and every
+               baseline entry still matches (an expired entry would
+               fail this step);
   2. catches — a synthetic bad tree (tracer branch + host sync +
                trace-time impurity, an unguarded cross-thread write,
-               and an unregistered-knob read) makes the CLI exit
-               non-zero with file:line findings for all three pass
+               an unregistered-knob read, an undeclared/unbound mesh
+               axis + in_specs arity mismatch + replicated embedding
+               spec, a config read reaching a cached program + an
+               unkeyed shape capture + an immediately-invoked jit, and
+               a hand-rolled fused-step builder) makes the CLI exit
+               non-zero with file:line findings for all six pass
                families;
   3. exact   — the in-process API pins the synthetic findings to their
                exact rule ids and line numbers, so the passes don't
@@ -82,6 +86,74 @@ def setup():
 '''
 # expected: unregistered-knob@5
 
+BAD_SHARD = '''\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+AXES = ("dp",)
+
+
+def lookup(table, ids, mesh):
+    def _shard(tbl, u):
+        return jax.lax.psum(tbl, "tp")
+    return shard_map(_shard, mesh=mesh, in_specs=(P("dp", None),),
+                     out_specs=P())(table, ids)
+
+
+SPECS = {"embed": P()}
+'''
+# expected: undeclared-axis@10 + unbound-axis@10 ("tp" vs AXES/("dp",)
+# in_spec), spec-arity@11 (1 spec, 2 params), replicated-embedding@15
+
+BAD_CACHE = '''\
+import jax
+from . import config
+
+
+class Runner(object):
+    def __init__(self):
+        self._progs = {}
+        self.items = ()
+
+    def set_items(self, xs):
+        self.items = xs
+
+    def _prog(self, shape):
+        cap = config.get("io.depth")
+        n = len(self.items)
+
+        def run(x):
+            return x * cap + n
+
+        prog = self._progs[shape] = jax.jit(run)
+        return prog
+
+
+def hot(x):
+    return jax.jit(lambda v: v + 1)(x)
+'''
+# expected: stale-knob-key@14 (config read baked into a cached program,
+# no epoch), unkeyed-capture@15 (len of mutable state, not in the key),
+# uncached-jit@25
+
+BAD_SEAM = '''\
+import jax
+from . import resilience as _res
+
+
+class Stepper(object):
+    def _build(self):
+        def step(p, g, s):
+            finite = _res.all_finite(g)
+            p2 = _res.select_tree(finite, p, p)
+            s2 = _res.guarded_streak(finite, s, "x")
+            return p2, s2
+        return jax.jit(step, donate_argnums=(0,))
+'''
+# expected: duplicate-step@8 (Stepper._build: traced fold + donation
+# outside the sanctioned core)
+
 FIXTURE_CONFIG = '''\
 def register_knob(name, env, type_, default, doc=""):
     pass
@@ -102,7 +174,10 @@ def write_bad_tree(root):
                       ("config.py", FIXTURE_CONFIG),
                       ("bad_jit.py", BAD_JIT),
                       ("bad_locks.py", BAD_LOCKS),
-                      ("bad_drift.py", BAD_DRIFT)):
+                      ("bad_drift.py", BAD_DRIFT),
+                      ("bad_shard.py", BAD_SHARD),
+                      ("bad_cache.py", BAD_CACHE),
+                      ("bad_seam.py", BAD_SEAM)):
         with open(os.path.join(pkg, rel), "w") as f:
             f.write(body)
 
@@ -134,7 +209,10 @@ def main():
             rc, out = run_cli("--root", tmp, "--no-baseline")
             assert rc != 0, "mxlint passed a tree with planted bugs"
             for needle in ("bad_jit.py:", "bad_locks.py:",
-                           "bad_drift.py:5:", "unregistered-knob"):
+                           "bad_drift.py:5:", "unregistered-knob",
+                           "bad_shard.py:11:", "spec-arity",
+                           "bad_cache.py:25:", "uncached-jit",
+                           "bad_seam.py:8:", "duplicate-step"):
                 assert needle in out, \
                     "CLI output lacks %r:\n%s" % (needle, out)
             result["catches"] = {"rc": rc,
@@ -151,14 +229,26 @@ def main():
                          ("bad_jit.py", "host-sync", 10),
                          ("bad_locks.py", "unguarded-write", 13),
                          ("bad_locks.py", "unguarded-read", 16),
-                         ("bad_drift.py", "unregistered-knob", 5)):
+                         ("bad_drift.py", "unregistered-knob", 5),
+                         ("bad_shard.py", "undeclared-axis", 10),
+                         ("bad_shard.py", "unbound-axis", 10),
+                         ("bad_shard.py", "spec-arity", 11),
+                         ("bad_shard.py", "replicated-embedding", 15),
+                         ("bad_cache.py", "stale-knob-key", 14),
+                         ("bad_cache.py", "unkeyed-capture", 15),
+                         ("bad_cache.py", "uncached-jit", 25),
+                         ("bad_seam.py", "duplicate-step", 8)):
                 assert want in got, "missing finding %r; got %r" \
                     % (want, sorted(got))
             result["exact"] = {"findings": len(rep.active)}
 
+        # typical: ~3s. The hard ceiling is deliberately loose — it
+        # exists to catch pathological regressions (an accidental jax
+        # import, a pass losing its prefilter), not scheduler noise on
+        # the single-core CI box running the full not-slow tier
         result["elapsed_s"] = round(time.perf_counter() - t_main, 3)
-        assert result["elapsed_s"] < 5.0, \
-            "smoke exceeded the 5s budget: %.3fs" % result["elapsed_s"]
+        assert result["elapsed_s"] < 10.0, \
+            "smoke exceeded the 10s ceiling: %.3fs" % result["elapsed_s"]
         result["ok"] = True
     except Exception as exc:  # noqa: BLE001 — the JSON line IS the report
         result["error"] = "%s: %s" % (type(exc).__name__, exc)
