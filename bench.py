@@ -382,6 +382,11 @@ def run_bench(runs_out):
     except Exception as e:  # noqa: BLE001
         runs_out.append({"mode": "transformer_kernels",
                          "error": "%s: %s" % (type(e).__name__, e)})
+    try:
+        autotune_config(runs_out, on_tpu)
+    except Exception as e:  # noqa: BLE001
+        runs_out.append({"mode": "autotune",
+                         "error": "%s: %s" % (type(e).__name__, e)})
 
     result = _summarize(runs_out)
     result.update(platform=platform, device_kind=kind)
@@ -1119,6 +1124,89 @@ def transformer_kernels_config(runs_out, on_tpu):
         _cfg.set("runtime.stack_mode", "scan")
 
 
+def autotune_config(runs_out, on_tpu):
+    """Secondary: mx.perf.autotune tuned-vs-untuned on the attention hot
+    path (BENCH_r06).  Three legs against one [B,H,S,D] problem:
+
+    * untuned — ``perf.autotune=off``: the tier's legacy routing (flash
+      wherever feasible, default block_q), no measured picks anywhere;
+    * search — the one-time measured block_q sweep in ``measure`` mode,
+      winner persisted to a private cache; its wall cost is the price a
+      cold site pays exactly once per (config-fingerprint, device);
+    * tuned — a fresh program traced AFTER the search: the cached
+      winner applies at trace time with zero re-measurement (the
+      ``autotune.measure`` counter delta across the timed leg is
+      asserted into the row, not assumed).
+    """
+    import tempfile
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import autotune as _autotune
+    from mxnet_tpu import config as _cfg
+    from mxnet_tpu import kernels as _kernels
+    from mxnet_tpu import telemetry as _tel
+
+    B, H, S, D = (4, 8, 1024, 64) if on_tpu else (1, 2, 128, 32)
+    iters = 20 if on_tpu else 3
+    rng = np.random.RandomState(9)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D), dt) for _ in range(3))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    def attn(q, k, v):
+        return _kernels.attention(q, k, v, causal=True)
+
+    cache = os.path.join(tempfile.mkdtemp(prefix="mxtpu_bench_at_"),
+                         "autotune.json")
+    try:
+        _cfg.set("perf.autotune", "off")
+        _autotune.reset()
+        ms_off = timed(jax.jit(attn), q, k, v)
+        runs_out.append({"mode": "autotune", "path": "untuned",
+                         "shape": [B, H, S, D],
+                         "wall_ms": round(ms_off, 3)})
+
+        _cfg.set("perf.autotune_cache", cache)
+        _cfg.set("perf.autotune", "measure")
+        _autotune.reset()
+        t0 = time.perf_counter()
+        entry = _autotune.search_attention(
+            (B, H, S, D), (B, H, S, D), str(q.dtype), True)
+        search_ms = (time.perf_counter() - t0) * 1e3
+        runs_out.append({"mode": "autotune", "path": "search",
+                         "search_ms": round(search_ms, 1),
+                         "impl": entry.get("impl"),
+                         "block_q": entry.get("block_q"),
+                         "parity": entry.get("parity"),
+                         "speedup": entry.get("speedup"),
+                         "candidates": entry.get("candidates")})
+
+        m0 = _tel.counter("autotune.measure").value
+        ms_tuned = timed(jax.jit(attn), q, k, v)  # fresh trace: pick applies
+        re_measure = _tel.counter("autotune.measure").value - m0
+        runs_out.append({"mode": "autotune", "path": "tuned",
+                         "wall_ms": round(ms_tuned, 3),
+                         "impl": entry.get("impl"),
+                         "re_measure": re_measure})
+        runs_out.append({"mode": "autotune", "path": "delta",
+                         "tuned_over_untuned":
+                             round(ms_off / max(ms_tuned, 1e-9), 3),
+                         "search_ms": round(search_ms, 1)})
+    finally:
+        _cfg.unset("perf.autotune")
+        _cfg.unset("perf.autotune_cache")
+        _autotune.reset()
+
+
 def _summarize(runs):
     """One JSON result from the completed sweep configs (best bf16 TRAIN
     run wins — inference runs are reported in `runs` but never headline,
@@ -1246,6 +1334,19 @@ def _summarize(runs):
             "unroll_over_scan_build":
                 k_runs.get("stack_speedup", {}).get(
                     "unroll_over_scan_build"),
+        }
+    a_runs = {r.get("path"): r for r in runs
+              if r.get("mode") == "autotune"}
+    if "tuned" in a_runs and "untuned" in a_runs:
+        secondary["autotune_delta"] = {
+            "untuned_ms": a_runs["untuned"]["wall_ms"],
+            "tuned_ms": a_runs["tuned"]["wall_ms"],
+            "unit": "ms",
+            "tuned_over_untuned":
+                a_runs.get("delta", {}).get("tuned_over_untuned"),
+            "winner": a_runs["tuned"].get("impl"),
+            "search_ms": a_runs.get("delta", {}).get("search_ms"),
+            "re_measure": a_runs["tuned"].get("re_measure"),
         }
     return dict(secondary, **{
         "metric": "resnet50_train_throughput",

@@ -19,7 +19,8 @@ from __future__ import annotations
 import os
 from collections import namedtuple
 
-__all__ = ["register_knob", "get", "set", "describe", "knobs", "Knob"]
+__all__ = ["register_knob", "get", "set", "unset", "source", "describe",
+           "knobs", "Knob"]
 
 Knob = namedtuple("Knob", ["name", "env", "type", "default", "doc"])
 
@@ -52,6 +53,22 @@ def get(name):
     return knob.default
 
 
+def source(name):
+    """Where the current value of ``name`` comes from: ``'override'``
+    (programmatic set()), ``'env'`` (its environment variable) or
+    ``'default'`` (the registry default).  Policy code uses this to
+    distinguish an operator's explicit choice from a shipped default —
+    e.g. the kernel tier's default-on graduation gates routing on
+    measured wins only when ``kernels.enabled`` is still at its
+    default, while an explicit on/off is honored verbatim."""
+    knob = _KNOBS[name]
+    if name in _OVERRIDES:
+        return "override"
+    if os.environ.get(knob.env) is not None:
+        return "env"
+    return "default"
+
+
 def set(name, value):  # noqa: A001 — reference-parity name
     if name not in _KNOBS:
         raise KeyError("unknown knob %r (see mx.config.describe())" % name)
@@ -75,6 +92,29 @@ def set(name, value):  # noqa: A001 — reference-parity name
     _EPOCH += 1
     if hook is not None:
         hook(parsed)
+
+
+def unset(name):
+    """Drop a programmatic override so ``name`` falls back to its env
+    var / registry default — including its *source* (mx.perf.autotune's
+    knob-space search restores knobs this way, so a sweep can never
+    leave a default-source knob looking explicitly set).  Bumps the
+    epoch and re-fires the side-effect hook only when the effective
+    value actually changes."""
+    if name not in _KNOBS:
+        raise KeyError("unknown knob %r (see mx.config.describe())" % name)
+    if name not in _OVERRIDES:
+        return
+    old = get(name)
+    del _OVERRIDES[name]
+    new = get(name)
+    if new == old:
+        return
+    global _EPOCH
+    _EPOCH += 1
+    hook = _ON_SET.get(name)
+    if hook is not None:
+        hook(new)
 
 
 # Bumped by every set(): compiled-program caches that bake knob values in at
@@ -559,18 +599,23 @@ _ON_SET["serving.decode_slots"] = _positive_int_knob("serving.decode_slots")
 
 # Pallas kernel tier (docs/PERF_NOTES.md "Kernel tier")
 register_knob(
-    "kernels.enabled", "MXNET_TPU_KERNELS", bool, False,
+    "kernels.enabled", "MXNET_TPU_KERNELS", bool, True,
     "route the training hot path through the Pallas kernel tier "
     "(mx.kernels): fused flash-attention fwd+bwd under the transformer/"
     "BERT stack and the fused optimizer+cast epilogue inside the fused "
     "train steps (module fused_step_fn, SPMDTrainer, eager "
     "multi-precision updates). Shapes/optimizers the kernels cannot "
     "serve fall back to the XLA lowering per call site "
-    "(kernels.fallback counts them); off (default) keeps every traced "
-    "program byte-identical to the pre-kernel paths. On CPU/GPU the "
-    "kernels run through the Pallas interpreter (same numerics, no "
-    "speedup) — the knob is a TPU performance switch and a CPU parity "
-    "switch.")
+    "(kernels.fallback counts them). On (the default since round 16) is "
+    "GATED: while the knob sits at its default, each routed site only "
+    "takes a kernel after mx.perf.autotune proves parity plus a "
+    "measured speedup >= 1.0x on this device (kernels.gated_fallback "
+    "counts the sites that lose); setting the knob explicitly (env or "
+    "set()) bypasses the gate — on routes kernels wherever feasible, "
+    "off keeps every traced program byte-identical to the pre-kernel "
+    "paths. On CPU/GPU the kernels run through the Pallas interpreter "
+    "(same numerics, no speedup), so the gate statically routes "
+    "default-knob programs to the XLA lowering there.")
 register_knob(
     "kernels.vmem_budget", "MXNET_TPU_KERNELS_VMEM_BUDGET", int,
     2097152,  # 2 MiB — a literal, so static doc/drift tooling can read it
@@ -593,6 +638,39 @@ def _apply_kernels_vmem_budget(value):
 
 
 _ON_SET["kernels.vmem_budget"] = _apply_kernels_vmem_budget
+
+# measured config search over the kernel tier (mx.perf.autotune,
+# docs/PERF_NOTES.md "Autotune")
+register_knob(
+    "perf.autotune", "MXNET_TPU_AUTOTUNE", str, "auto",
+    "mx.perf.autotune mode. 'auto' (default): apply persisted winners "
+    "at trace time; on a cache miss, measure once and write through on "
+    "TPU, or statically route to the XLA lowering on interpreted "
+    "backends (CPU/GPU) where a Pallas kernel can never win. 'measure': "
+    "always run the measured search on a miss, even interpreted (what "
+    "tools/check_autotune.py and bench.py use). 'off': no search, no "
+    "cache — legacy routing (kernels wherever feasible when the tier "
+    "is on).")
+register_knob(
+    "perf.autotune_cache", "MXNET_TPU_AUTOTUNE_CACHE", str, "",
+    "path of the persisted tuning cache (JSON). Empty (default) = "
+    "<model_store.root>/autotune.json, i.e. ~/.mxnet/autotune.json. "
+    "Entries are keyed by program family/site + device kind + dominant "
+    "dtype + a fingerprint of the knob VALUES the kernels lower "
+    "against (notably kernels.vmem_budget), so a stale budget can "
+    "never resurrect block picks sized for a different VMEM window.")
+
+
+def _apply_perf_autotune(value):
+    v = (value or "").strip().lower()
+    if v not in ("off", "auto", "measure"):
+        # reject at set() time and revert (the nanguard pattern)
+        _OVERRIDES.pop("perf.autotune", None)
+        raise ValueError("perf.autotune must be 'off', 'auto' or "
+                         "'measure', got %r" % (value,))
+
+
+_ON_SET["perf.autotune"] = _apply_perf_autotune
 
 # transformer layer-stack program tuning (runtime.scan_stack,
 # docs/PERF_NOTES.md "Kernel tier")

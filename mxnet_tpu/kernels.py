@@ -7,17 +7,25 @@ deciding when to swap a library op for a hand-fused RTC kernel
 config knob plus a shape/platform feasibility check, because silent
 kernel swaps are how frameworks grow haunted performance.
 
-Routing contract (docs/PERF_NOTES.md "Kernel tier"):
+Routing contract (docs/PERF_NOTES.md "Kernel tier" + "Autotune"):
 
-* everything is OFF by default — with ``kernels.enabled`` false the
-  routed entry points trace the exact same XLA ops as before the kernel
-  tier existed, so programs are byte-identical;
-* with the knob on, supported shapes go through the Pallas kernel
-  (``kernels.flash_attention`` counter) and unsupported ones fall back
-  to the XLA lowering (``kernels.fallback`` counter) — never an error;
+* the tier is ON by default since round 16, but a *default-source* knob
+  is GATED: each routed site only takes a kernel after mx.perf.autotune
+  proves bitwise-or-tolerance parity plus a measured speedup >= 1.0x on
+  this device (``kernels.gated_fallback`` counts losing sites, which
+  fall back to the XLA lowering permanently — the PR 11 AOT-rejection
+  contract).  On interpreted backends the gate statically routes to
+  XLA, so default-knob CPU programs stay byte-identical to the
+  pre-tier lowering;
+* an EXPLICIT ``kernels.enabled`` (env var or ``config.set``) bypasses
+  the gate: off traces the exact pre-tier XLA ops (byte-identical
+  programs); on routes supported shapes through the Pallas kernel
+  (``kernels.flash_attention`` counter) with tuned block sizes when a
+  winner is cached, falling back only on infeasible shapes
+  (``kernels.fallback`` counter) — never an error;
 * the decision is trace-time python, so a jitted program contains one
-  path only and toggling the knob retraces (config epoch / trainer
-  cache keys handle that).
+  path only; toggling the knob or landing a new autotune winner
+  retraces (config epoch / autotune generation in the cache keys).
 
 On CPU the kernels run through the Pallas interpreter — same numerics,
 no TPU needed — which is what the parity gates in
@@ -52,10 +60,16 @@ def enabled():
 def fused_step_enabled(optimizer):
     """True when ``optimizer`` should update through its fused
     Pallas epilogue: tier on + the optimizer implements ``step_fused``
-    + its step math is jit-safe."""
-    return (enabled()
+    + its step math is jit-safe + the autotune gate agrees (a
+    default-source tier only fuses where the measured epilogue won;
+    see mx.perf.autotune)."""
+    if not (enabled()
             and getattr(optimizer, "fused_step", False)
-            and getattr(optimizer, "jit_safe", True))
+            and getattr(optimizer, "jit_safe", True)):
+        return False
+    from . import autotune as _autotune
+    pick = _autotune.fused_step_pick(optimizer)
+    return pick is None or pick.get("impl") == "fused"
 
 
 def note_fused_step():
@@ -71,6 +85,12 @@ def flash_unsupported_reason(q, k, v, causal):
     under jit.  A non-None reason routes to the XLA fallback."""
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         return "rank != 4 (got q%s k%s v%s)" % (q.ndim, k.ndim, v.ndim)
+    # jax.export shape polymorphism: symbolic dims can't answer the
+    # block/budget comparisons below, and a kernel specialized to one
+    # concrete shape defeats the point of a polymorphic artifact
+    if not all(isinstance(d, int)
+               for d in tuple(q.shape) + tuple(k.shape) + tuple(v.shape)):
+        return "symbolic shape (q%s kv%s)" % (q.shape, k.shape)
     if k.shape != v.shape:
         return "k/v shapes differ: %s vs %s" % (k.shape, v.shape)
     if q.shape[:2] != k.shape[:2]:
@@ -100,8 +120,11 @@ def attention(q, k, v, causal=False, scale=None):
     Tier off → the plain XLA lowering (parallel.ring_attention.attention),
     traced identically to the pre-kernel-tier program.  Tier on →
     the fused Pallas flash kernel when the shape qualifies
-    (``kernels.flash_attention`` counter), XLA fallback otherwise
-    (``kernels.fallback`` counter)."""
+    (``kernels.flash_attention`` counter; the tuned ``block_q`` applies
+    when mx.perf.autotune has a winner for this site), the XLA lowering
+    when the shape can't take the kernel (``kernels.fallback``) or when
+    the default-source gate measured the kernel slower / not bit-close
+    (``kernels.gated_fallback``)."""
     from .parallel.ring_attention import attention as _xla_attention
     if enabled():
         q = jnp.asarray(q)
@@ -109,9 +132,19 @@ def attention(q, k, v, causal=False, scale=None):
         v = jnp.asarray(v)
         reason = flash_unsupported_reason(q, k, v, causal)
         if reason is None:
-            _telemetry.counter("kernels.flash_attention").inc()
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        _telemetry.counter("kernels.fallback").inc()
+            from . import autotune as _autotune
+            pick = _autotune.attention_pick(tuple(q.shape), tuple(k.shape),
+                                            str(q.dtype), causal, scale)
+            if pick is None or pick.get("impl") == "flash":
+                _telemetry.counter("kernels.flash_attention").inc()
+                bq = int(pick.get("block_q") or 128) if pick else 128
+                return flash_attention(q, k, v, causal=causal,
+                                       scale=scale, block_q=bq)
+            # the measured gate lost (or the platform statically can't
+            # win): the XLA lowering IS the winner for this site
+            _telemetry.counter("kernels.gated_fallback").inc()
+        else:
+            _telemetry.counter("kernels.fallback").inc()
     return _xla_attention(q, k, v, causal=causal, scale=scale)
 
 

@@ -430,17 +430,25 @@ def _flat2d(a):
     return a.reshape(1, 1)
 
 
-def _epilogue_call(kernel, arrays, scalars, out_dtypes):
+def _epilogue_call(kernel, arrays, scalars, out_dtypes, block_rows=None):
     """Launch an elementwise epilogue kernel over same-shape operands:
     arrays flatten to 2-D and stream through shared row blocks; scalars
-    ride as (1,1) blocks pinned to every grid step."""
+    ride as (1,1) blocks pinned to every grid step.  ``block_rows``
+    overrides the VMEM-budget row-block derivation (mx.perf.autotune
+    passes measured winners through); it still snaps to the largest
+    divisor of n that fits, so an awkward tuned value can never break
+    the exact-tiling requirement."""
     from jax.experimental import pallas as pl
     from ..rtc import interpret_mode
     shape = arrays[0].shape
     flats = [_flat2d(a) for a in arrays]
     n, d = flats[0].shape
     itemsize = max(f.dtype.itemsize for f in flats)
-    rows = _row_block(n, d * itemsize * (len(arrays) + len(out_dtypes)))
+    row_bytes = d * itemsize * (len(arrays) + len(out_dtypes))
+    if block_rows is None:
+        rows = _row_block(n, row_bytes)
+    else:
+        rows = _row_block(n, 1, budget=min(int(block_rows), n))
     scal = [jnp.asarray(s, jnp.float32).reshape(1, 1) for s in scalars]
     outs = pl.pallas_call(
         kernel,
@@ -455,13 +463,17 @@ def _epilogue_call(kernel, arrays, scalars, out_dtypes):
     return [o.reshape(shape) for o in outs]
 
 
-def fused_sgd_step(weight, grad, state, lr, wd, momentum, out_dtype=None):
+def fused_sgd_step(weight, grad, state, lr, wd, momentum, out_dtype=None,
+                   block_rows=None):
     """Single-kernel SGD(+momentum) update with cast epilogue.
 
     ``weight`` is the f32 master; returns
     ``(weight_cast[out_dtype], new_master, new_state)`` — identical math
     and op order to ``SGD.step`` followed by ``astype``, so the result is
-    bitwise-equal to the master-copy round trip it replaces."""
+    bitwise-equal to the master-copy round trip it replaces.
+    ``block_rows`` is the tunable row-block size (None = derive from the
+    VMEM budget); the math is row-wise, so any block size computes the
+    same bits."""
     weight = jnp.asarray(weight)
     grad = jnp.asarray(grad)
     out_dtype = jnp.dtype(out_dtype) if out_dtype is not None \
@@ -469,17 +481,17 @@ def fused_sgd_step(weight, grad, state, lr, wd, momentum, out_dtype=None):
     if momentum == 0.0:
         lp, nw = _epilogue_call(
             _sgd_nomom_epilogue_kernel, [weight, grad], [lr, wd],
-            [out_dtype, weight.dtype])
+            [out_dtype, weight.dtype], block_rows=block_rows)
         return lp, nw, None
     lp, nw, mom = _epilogue_call(
         functools.partial(_sgd_epilogue_kernel, momentum),
         [weight, grad, state], [lr, wd],
-        [out_dtype, weight.dtype, state.dtype])
+        [out_dtype, weight.dtype, state.dtype], block_rows=block_rows)
     return lp, nw, mom
 
 
 def fused_adam_step(weight, grad, m, v, lr_t, wd, beta1, beta2, eps,
-                    out_dtype=None):
+                    out_dtype=None, block_rows=None):
     """Single-kernel Adam update with cast epilogue (see
     ``fused_sgd_step``); ``lr_t`` is the bias-corrected learning rate the
     caller computes from the traced step count."""
@@ -490,7 +502,7 @@ def fused_adam_step(weight, grad, m, v, lr_t, wd, beta1, beta2, eps,
     lp, nw, nm, nv = _epilogue_call(
         functools.partial(_adam_epilogue_kernel, beta1, beta2, eps),
         [weight, grad, m, v], [lr_t, wd],
-        [out_dtype, weight.dtype, m.dtype, v.dtype])
+        [out_dtype, weight.dtype, m.dtype, v.dtype], block_rows=block_rows)
     return lp, nw, (nm, nv)
 
 

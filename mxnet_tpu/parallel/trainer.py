@@ -588,12 +588,18 @@ class SPMDTrainer:
         # the traced step bodies bake in config-derived constants beyond
         # the guard/kernels knobs (the sparse path sizes its dedup
         # buffers from embedding.unique_size), so any config mutation —
-        # tracked by the epoch counter — invalidates the program cache
+        # tracked by the epoch counter — invalidates the program cache;
+        # likewise a fresh mx.perf.autotune winner (generation counter)
+        # must retrace so the tuned pick bakes in
+        from .. import autotune as _autotune
         epoch = _config.epoch()
+        agen = _autotune.generation()
         if self._jitted and (guard != self._guard_mode or
                              kmode != getattr(self, "_kernel_mode", kmode)
                              or epoch != getattr(self, "_config_epoch",
-                                                 epoch)):
+                                                 epoch)
+                             or agen != getattr(self, "_autotune_gen",
+                                                agen)):
             self._jitted.clear()  # knob flip: rebuild with/without the guard
         # the program cache is keyed by pad count: the pad-masked loss uses
         # a STATIC slice so its reduction is structurally identical to the
@@ -604,13 +610,18 @@ class SPMDTrainer:
             self._guard_mode = guard
             self._kernel_mode = kmode
             self._config_epoch = epoch
+            self._autotune_gen = agen
             from .. import perf as _perf
             # kernels=on earns its own program key; the OFF key is
             # unchanged from earlier rounds so perf artifacts stay
-            # comparable across releases
+            # comparable across releases.  A program built after an
+            # autotune winner landed gets its own key too, so the tuned
+            # and untuned registrations coexist in perf exports.
             pkey = "pad=%d/guard=%s" % (pad, guard)
             if kmode:
                 pkey += "/kernels=on"
+            if agen:
+                pkey += "/at%d" % agen
             with _tracing.span("spmd.compile", cat="spmd"):
                 jitted = self._jitted[pad] = _perf.wrap(
                     self._build(pad), "spmd", pkey, source="spmd")

@@ -64,7 +64,7 @@ __all__ = [
     "OP_CLASSES", "classify_op", "hlo_op_classes", "device_kind",
     "peak_flops", "peak_bandwidth", "roofline", "register_compiled",
     "programs", "program", "reset", "export", "wrap", "PerfProgram",
-    "configure_profile", "cost_analysis",
+    "configure_profile", "cost_analysis", "autotune",
 ]
 
 # ----------------------------------------------------------- peak tables
@@ -388,6 +388,7 @@ def export(path=None):
         "device_kind": device_kind(),
         "default_peak_tflops": DEFAULT_PEAK,
         "programs": programs(),
+        "autotune": autotune.export_entries(),
     }
     if path:
         with open(path, "w") as f:
@@ -627,6 +628,11 @@ def _load_trace_merge():
 # on telemetry rather than an import so telemetry stays dependency-free.
 from . import config as _config  # noqa: E402
 from . import telemetry as _telemetry_mod  # noqa: E402
+
+# mx.perf.autotune — the measured config search rides on this module's
+# namespace (it measures through the same jit machinery PerfProgram
+# captures); autotune imports perf lazily, so the cycle is benign.
+from . import autotune  # noqa: E402,F401
 
 _telemetry_mod._PERF_STEP_HOOK = _on_step
 

@@ -18,7 +18,8 @@ from __future__ import annotations
 from collections import namedtuple
 
 __all__ = ["Feature", "Features", "feature_list", "is_enabled",
-           "scan_stack", "stack_tuning", "checkpoint_policy"]
+           "scan_stack", "stack_tuning", "stack_candidates",
+           "checkpoint_policy"]
 
 Feature = namedtuple("Feature", ["name", "enabled"])
 
@@ -92,11 +93,26 @@ def is_enabled(feature_name):
 
 
 # --------------------------------------------------------- program tuning
+def stack_candidates():
+    """The discrete (mode, remat) grid mx.perf.autotune measures over:
+    every legal combination of the two validated knobs.  'unroll' pairs
+    with remat-off only — rematerializing an inlined stack re-traces
+    every layer body, which the scan path exists to avoid."""
+    return (("scan", ""), ("scan", "dots"), ("scan", "full"),
+            ("unroll", ""))
+
+
 def stack_tuning():
-    """The active (mode, remat) pair from the validated knobs
+    """The active (mode, remat) pair: the validated knobs
     ``runtime.stack_mode`` (scan|unroll) and ``runtime.remat``
-    (''|dots|full)."""
+    (''|dots|full) — or, while BOTH knobs sit at their defaults, a
+    persisted mx.perf.autotune winner for the layer stack (measured by
+    ``autotune.search_stack``; an explicit knob always wins)."""
+    from . import autotune as _autotune
     from . import config as _config
+    tuned = _autotune.stack_pick()
+    if tuned is not None:
+        return tuned
     return _config.get("runtime.stack_mode"), _config.get("runtime.remat")
 
 

@@ -951,8 +951,12 @@ class Executor:
                 for n in self._symbol.list_arguments()]
 
     def _fwd_fn(self, training):
+        from .. import autotune as _autotune
         from .. import config as _config
-        cache_key = (training, _config.epoch())  # knobs bake in at trace
+        # knob values AND mx.perf.autotune picks bake in at trace: the
+        # epoch tracks config mutations, the generation tracks freshly
+        # recorded tuning winners — either moving retraces
+        cache_key = (training, (_config.epoch(), _autotune.generation()))
         if cache_key not in self._fwd_cache:
             # evict programs compiled under superseded knob epochs
             self._fwd_cache = {k: v for k, v in self._fwd_cache.items()
@@ -1006,8 +1010,10 @@ class Executor:
         forward + backward fuse into a single XLA executable (replacing the
         reference's separate backward graph executor,
         src/executor/graph_executor.cc:91)."""
+        from .. import autotune as _autotune
         from .. import config as _config
-        key_sig = (tuple(wrt), _config.epoch())  # knobs bake in at trace
+        # knobs + autotune picks bake in at trace (see _fwd_fn)
+        key_sig = (tuple(wrt), (_config.epoch(), _autotune.generation()))
         if key_sig not in self._bwd_cache:
             # evict programs compiled under superseded knob epochs (same
             # invalidation contract as _fwd_fn: a config.set between calls
@@ -1071,8 +1077,9 @@ class Executor:
         # the program closes over the optimizer, so its identity (and the
         # scalars baked in at trace time) is part of the key; cached entries
         # keep their optimizer alive, so id() stays unambiguous
+        from .. import autotune as _autotune
         key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig, guard,
-                   _config.epoch())
+                   (_config.epoch(), _autotune.generation()))
         fn = self._fused_cache.get(key_sig)
         if fn is not None:
             return fn
