@@ -48,6 +48,7 @@ _LAZY = {
     "profiler": ".profiler",
     "telemetry": ".telemetry",
     "tracing": ".tracing",
+    "obs": ".obs",
     "resilience": ".resilience",
     "perf": ".perf",
     "kernels": ".kernels",

@@ -309,6 +309,67 @@ _ON_SET["tracing.watchdog"] = _apply_tracing_watchdog
 _ON_SET["tracing.watchdog_dir"] = _apply_tracing_watchdog_dir
 _ON_SET["tracing.ring_size"] = _apply_tracing_ring
 
+# operational plane: exporter + access log + SLOs (docs/OBSERVABILITY.md)
+register_knob(
+    "obs.listen", "MXNET_TPU_OBS_LISTEN", str, "",
+    "operational-plane exporter address as 'host:port' (port 0 binds an "
+    "ephemeral port; obs.exporter_address() reports it): starts a daemon "
+    "HTTP thread serving /metrics (Prometheus text rendered from the "
+    "telemetry registry, plus SLO burn rates), /healthz (breaker states, "
+    "batcher/engine liveness, KV-pool saturation, last-step age; non-200 "
+    "when unhealthy), and /varz (effective knobs with provenance). Empty "
+    "(default) disables — no thread, no socket.")
+register_knob(
+    "obs.access_log", "MXNET_TPU_OBS_ACCESS_LOG", str, "",
+    "per-request access log sink: 'jsonl:<path>' appends one JSON record "
+    "per serving/generation request (request_id = the span trace_id, "
+    "model, queue_ms, dispatch_ms, ttft_ms, tokens, bytes, outcome "
+    "ok|shed|deadline|breaker|error) that joins against the tracing.sink "
+    "Chrome trace on trace_id. Empty (default) disables — the serving hot "
+    "path gains one predicate per request.")
+register_knob(
+    "obs.slo", "MXNET_TPU_OBS_SLO", str, "",
+    "serving SLO objectives as 'key=value[,key=value...]': "
+    "'availability=99.9' (percent of requests that must not end "
+    "shed/deadline/breaker/error) and 'latency_p99_ms=50' (windowed p99 "
+    "bound on the timer named by 'timer=', default serving.request_ms). "
+    "Arms multi-window burn-rate tracking (5m/1h fast, 30m/6h slow) "
+    "exposed on /metrics and obs.slo_status(). Empty (default) disables.")
+
+
+def _apply_obs_listen(value):
+    from . import obs
+    try:
+        obs.configure_listen(value)
+    except (ValueError, OSError):
+        # reject at set() time and revert (the perf.profile pattern): a
+        # typo'd address or un-bindable port must not linger as the override
+        _OVERRIDES.pop("obs.listen", None)
+        raise
+
+
+def _apply_obs_access_log(value):
+    from . import obs
+    try:
+        obs.configure_access_log(value)
+    except ValueError:
+        _OVERRIDES.pop("obs.access_log", None)
+        raise
+
+
+def _apply_obs_slo(value):
+    from . import obs
+    try:
+        obs.configure_slo(value)
+    except ValueError:
+        _OVERRIDES.pop("obs.slo", None)
+        raise
+
+
+_ON_SET["obs.listen"] = _apply_obs_listen
+_ON_SET["obs.access_log"] = _apply_obs_access_log
+_ON_SET["obs.slo"] = _apply_obs_slo
+
 # compiled-program cost attribution (docs/OBSERVABILITY.md)
 register_knob(
     "perf.profile", "MXNET_TPU_PROFILE", str, "",

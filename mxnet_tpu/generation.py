@@ -89,9 +89,11 @@ import jax
 
 from . import config as _config
 from . import io as _io
+from . import obs as _obs
 from . import telemetry as _telemetry
 from .serving import (CircuitOpenError, DeadlineExceededError,
-                      ServerOverloadedError, ServingError)
+                      ServerOverloadedError, ServingError,
+                      _access_outcome)
 
 __all__ = ["GenerationEngine"]
 
@@ -108,9 +110,11 @@ class _GenRequest:
     stream resolves, stamped for TTFT / deadline accounting."""
 
     __slots__ = ("prompt", "plen", "max_new", "eos_id", "future",
-                 "t_submit", "deadline", "need", "stall_counted")
+                 "t_submit", "deadline", "need", "stall_counted",
+                 "trace_id")
 
-    def __init__(self, prompt, max_new, eos_id, deadline_ms, need):
+    def __init__(self, prompt, max_new, eos_id, deadline_ms, need,
+                 trace_id=None):
         self.prompt = prompt
         self.plen = int(prompt.shape[0])
         self.max_new = int(max_new)
@@ -121,6 +125,7 @@ class _GenRequest:
             if deadline_ms and deadline_ms > 0 else None
         self.need = int(need)          # pages for prompt + max_new
         self.stall_counted = False     # kv_pool_exhausted counted once
+        self.trace_id = trace_id       # submit span id for the access log
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -180,6 +185,9 @@ class GenerationEngine:
         self._stopping = False           # guarded-by: _cond
         self._abort = False              # guarded-by: _cond
         self._dead = None                # guarded-by: _cond — crash exc
+        # last engine-loop iteration (the watchdog probe's liveness clock)
+        self._last_iteration = _time.perf_counter()  # guarded-by: _cond
+        self._probe_name = "serving-generate-%x" % id(self)
         # guarded-by[writes]: _cond — stop() joins outside the lock
         self._thread = None
         # Engine-thread-only state: the page pool arrays and decode slots
@@ -265,10 +273,16 @@ class GenerationEngine:
             self._abort = False
             self._dead = None
             self._started = True
+            self._last_iteration = _time.perf_counter()
             self._thread = threading.Thread(
                 target=_tracing.wrap_context(self._supervise), daemon=True,
                 name="mx-serving-generate-%s" % self.name)
         self._thread.start()
+        # the serving batcher has carried a stall probe since PR-3; the
+        # generation engine gets its sibling here — KV-pool occupancy,
+        # decode-loop liveness and oldest in-flight request age land in
+        # the watchdog hang report
+        _tracing.register_stall_probe(self._probe_name, self._stall_probe)
         return self
 
     def stop(self, drain=True, timeout_s=30.0):
@@ -288,6 +302,8 @@ class GenerationEngine:
                 _telemetry.counter("serving.stop_timeout").inc()
                 _LOG.warning("serving: generation engine %r did not "
                              "drain within %.1fs", self.name, timeout_s)
+        from . import tracing as _tracing
+        _tracing.unregister_stall_probe(self._probe_name)
         with self._cond:
             self._started = False
             self._thread = None
@@ -319,9 +335,15 @@ class GenerationEngine:
                 "%d (serving.kv_pages) — shorten the request or grow the "
                 "pool" % (self.name, need, self.num_pages))
         _telemetry.counter("serving.requests").inc()
+        # the enclosing serving.submit span's trace_id (None when tracing
+        # is off) rides the request so its access record joins the trace
+        from . import tracing as _tracing
+        sp = _tracing.current_span()
+        trace_id = sp.trace_id if sp is not None else None
         breaker = self.breaker
         if breaker is not None and breaker.rejects_submit():
             _telemetry.counter("serving.breaker_rejected").inc()
+            _obs.log_access(self.name, "breaker", request_id=trace_id)
             raise CircuitOpenError(
                 "model %r circuit breaker is OPEN after %d consecutive "
                 "dispatch failure(s); failing fast for %.0fms more"
@@ -330,7 +352,8 @@ class GenerationEngine:
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         req = _GenRequest(prompt, max_new, eos_id,
-                          float(deadline_ms or 0.0), need)
+                          float(deadline_ms or 0.0), need,
+                          trace_id=trace_id)
         with self._cond:
             if self._dead is not None:
                 exc = self._dead
@@ -354,6 +377,7 @@ class GenerationEngine:
             _telemetry.counter("serving.shed_requests").inc()
             _telemetry.counter(
                 "serving.shed_requests.%s" % self.name).inc()
+            _obs.log_access(self.name, "shed", request_id=trace_id)
             raise ServerOverloadedError(
                 "generation queue for model %r is at serving.max_pending"
                 "=%d; request shed — back off and retry"
@@ -401,15 +425,26 @@ class GenerationEngine:
         return [s for s in self._slots if s is not None]
 
     def _fail_all(self, reqs, exc):
+        outcome = _access_outcome(exc)
+        err = ("%s: %s" % (type(exc).__name__, exc)
+               if outcome == "error" else None)
         for req in reqs:
             if not req.future.done():
                 req.future.set_exception(exc)
+                if _obs.access_log_enabled():
+                    _obs.log_access(
+                        self.name, outcome, request_id=req.trace_id,
+                        queue_ms=(_time.perf_counter() - req.t_submit)
+                        * 1e3, error=err)
 
     def _fail_active(self, exc):
         """Fail every in-flight sequence and recycle its pages (the pool
         arrays were donated into the failed dispatch, so their state is
         gone — rebuild zeroed)."""
         freed = []
+        outcome = _access_outcome(exc)
+        err = ("%s: %s" % (type(exc).__name__, exc)
+               if outcome == "error" else None)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -417,6 +452,12 @@ class GenerationEngine:
             freed.extend(slot.pages)
             if not slot.req.future.done():
                 slot.req.future.set_exception(exc)
+                if _obs.access_log_enabled():
+                    _obs.log_access(
+                        self.name, outcome,
+                        request_id=slot.req.trace_id,
+                        ttft_ms=slot.ttft_ms,
+                        tokens=len(slot.tokens), error=err)
         if freed:
             with self._cond:
                 self._free.extend(freed)
@@ -462,6 +503,7 @@ class GenerationEngine:
         while True:
             now = _time.perf_counter()
             with self._cond:
+                self._last_iteration = now
                 expired = self._harvest_expired_locked(now)
                 admitted = self._admit_locked(now)
                 active = self._active()
@@ -512,11 +554,14 @@ class GenerationEngine:
             _telemetry.counter(
                 "serving.deadline_exceeded.%s" % self.name).inc()
             if not req.future.done():
+                queued_ms = (_time.perf_counter() - req.t_submit) * 1e3
                 req.future.set_exception(DeadlineExceededError(
                     "generation request for model %r expired in queue "
                     "before prefill (queued %.1fms, deadline passed)"
-                    % (self.name, (_time.perf_counter() - req.t_submit)
-                       * 1e3)))
+                    % (self.name, queued_ms)))
+                _obs.log_access(self.name, "deadline",
+                                request_id=req.trace_id,
+                                queue_ms=queued_ms)
 
     def _dispatch_failed(self, exc):
         """Shared failure path: the donated pool is poisoned, so every
@@ -547,6 +592,9 @@ class GenerationEngine:
                 req.future.set_exception(CircuitOpenError(
                     "model %r circuit breaker is OPEN; prefill failed "
                     "fast, retry after the cooldown" % (self.name,)))
+                _obs.log_access(
+                    self.name, "breaker", request_id=req.trace_id,
+                    queue_ms=(_time.perf_counter() - req.t_submit) * 1e3)
             return True   # engine itself is fine
         s_bucket = gp.prefill_bucket(req.plen)
         w_s = _math.ceil(s_bucket / gp.page_size)
@@ -597,6 +645,9 @@ class GenerationEngine:
                 freed.extend(s.pages)
                 if not s.req.future.done():
                     s.req.future.set_exception(exc)
+                    _obs.log_access(
+                        self.name, "breaker", request_id=s.req.trace_id,
+                        ttft_ms=s.ttft_ms, tokens=len(s.tokens))
             with self._cond:
                 self._free.extend(freed)
                 self._cond.notify_all()
@@ -658,6 +709,12 @@ class GenerationEngine:
         _telemetry.timer("serving.generate_request_ms").observe(wall_ms)
         if not req.future.done():
             req.future.set_result(_np.asarray(slot.tokens, _np.int32))
+            if _obs.access_log_enabled():
+                _obs.log_access(
+                    self.name, "ok", request_id=req.trace_id,
+                    dispatch_ms=wall_ms, ttft_ms=slot.ttft_ms,
+                    tokens=len(slot.tokens),
+                    bytes=len(slot.tokens) * 4)
         if _telemetry.enabled():
             _telemetry.log_event(
                 "serving_generate", model=self.name,
@@ -669,6 +726,41 @@ class GenerationEngine:
                 pool_exhausted_wait=req.stall_counted,
                 breaker=self.breaker.state
                 if self.breaker is not None else "closed")
+
+    def _stall_probe(self, interval_s):
+        """mx.tracing stall probe (registered in :meth:`start`): reports
+        the engine wedged when work is pending but the decode loop has
+        not turned over within the watchdog interval.  Mirrors the
+        one-shot ``Server`` probe registered in serving.py."""
+        now = _time.perf_counter()
+        with self._cond:
+            queued = len(self._queue)
+            free = len(self._free)
+            last_iter = self._last_iteration
+            thread = self._thread
+            oldest_q = min((r.t_submit for r in self._queue),
+                           default=None)
+        # advisory cross-thread read of the engine-owned slot table (the
+        # same precedent stats() relies on) — staleness is acceptable here
+        active = self._active()
+        if queued == 0 and not active:
+            return None
+        if now - last_iter < interval_s:
+            return None
+        ages = [now - s.req.t_submit for s in active]
+        if oldest_q is not None:
+            ages.append(now - oldest_q)
+        return {
+            "model": self.name,
+            "queued": queued,
+            "active": len(active),
+            "kv_pages": self.num_pages,
+            "kv_pages_free": free,
+            "since_last_iteration_s": round(now - last_iter, 3),
+            "engine_alive": bool(thread is not None
+                                 and thread.is_alive()),
+            "oldest_request_age_s": round(max(ages), 3) if ages else 0.0,
+        }
 
     # ------------------------------------------------------------- stats
     def stats(self):

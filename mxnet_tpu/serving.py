@@ -114,6 +114,7 @@ import jax
 
 from . import config as _config
 from . import io as _io
+from . import obs as _obs
 from . import telemetry as _telemetry
 
 __all__ = ["Server", "ServingError", "ServerOverloadedError",
@@ -159,14 +160,29 @@ class _BatcherCrashError(OSError):
     restart backoff and bounds the restart budget."""
 
 
+def _access_outcome(exc):
+    """Map a request-terminal exception to its access-log outcome (the
+    mx.obs vocabulary: ok|shed|deadline|breaker|error)."""
+    if isinstance(exc, CircuitOpenError):
+        return "breaker"
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline"
+    if isinstance(exc, ServerOverloadedError):
+        return "shed"
+    return "error"
+
+
 class _Request:
     """One caller request: host-side rows plus the future its output rows
-    resolve, stamped with the submit time for queue-delay accounting and
-    an optional absolute deadline."""
+    resolve, stamped with the submit time for queue-delay accounting, an
+    optional absolute deadline, and the submit span's trace_id so the
+    mx.obs access-log record joins against the Chrome trace."""
 
-    __slots__ = ("model", "data", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("model", "data", "rows", "future", "t_submit", "deadline",
+                 "trace_id")
 
-    def __init__(self, model, data, future, deadline_ms=0.0):
+    def __init__(self, model, data, future, deadline_ms=0.0,
+                 trace_id=None):
         self.model = model
         self.data = data
         self.rows = int(data.shape[0])
@@ -174,6 +190,7 @@ class _Request:
         self.t_submit = _time.perf_counter()
         self.deadline = (self.t_submit + float(deadline_ms) * 1e-3) \
             if deadline_ms and deadline_ms > 0 else None
+        self.trace_id = trace_id
 
     def expired(self, now=None):
         if self.deadline is None:
@@ -660,6 +677,7 @@ class Server:
                 name="mx-serving-batcher")
         self._thread.start()
         _tracing.register_stall_probe(self._probe_name, self._stall_probe)
+        _obs.register_health_source(self._probe_name, self._health)
         return self
 
     def stop(self, drain=True, timeout_s=30.0):
@@ -684,6 +702,10 @@ class Server:
             if not req.future.done():
                 req.future.set_exception(
                     ServingError("server stopped without drain"))
+                _obs.log_access(req.model, "error",
+                                request_id=req.trace_id,
+                                error="ServingError: server stopped "
+                                "without drain")
         thread = self._thread
         if thread is not None:
             thread.join(timeout=timeout_s)
@@ -696,6 +718,7 @@ class Server:
                     timeout_s)
         from . import tracing as _tracing
         _tracing.unregister_stall_probe(self._probe_name)
+        _obs.unregister_health_source(self._probe_name)
         with self._cond:
             engines = list(self._generation.values())
         for engine in engines:
@@ -745,7 +768,11 @@ class Server:
         model's breaker is open."""
         from . import tracing as _tracing
         from .ndarray.ndarray import NDArray
-        with _tracing.span("serving.submit", cat="serving", model=name):
+        with _tracing.span("serving.submit", cat="serving",
+                           model=name) as sp:
+            # the submit span's trace_id rides the request so the access
+            # log joins the Chrome trace (None while tracing is off)
+            trace_id = sp.trace_id
             entry = self._entry(name)
             arr = _np.asarray(data._data if isinstance(data, NDArray)
                               else data)
@@ -754,6 +781,7 @@ class Server:
             breaker = entry.breaker
             if breaker is not None and breaker.rejects_submit():
                 _telemetry.counter("serving.breaker_rejected").inc()
+                _obs.log_access(name, "breaker", request_id=trace_id)
                 raise CircuitOpenError(
                     "model %r circuit breaker is OPEN after %d "
                     "consecutive dispatch failure(s); failing fast for "
@@ -766,14 +794,18 @@ class Server:
             deadline_ms = float(deadline_ms or 0.0)
             cap = entry.capacity
             if arr.shape[0] <= cap:
-                req = _Request(name, arr, Future(), deadline_ms)
+                req = _Request(name, arr, Future(), deadline_ms,
+                               trace_id=trace_id)
                 fut = self._enqueue(req)
                 fut._mx_requests = (req,)
                 return fut
             # oversized request: split into cap-row chunks, re-concatenate
+            # (each admitted chunk gets its own access record, all sharing
+            # the submit span's request_id)
             chunks = [arr[i:i + cap] for i in range(0, arr.shape[0], cap)]
             _telemetry.counter("serving.request_chunks").inc(len(chunks))
-            reqs = [_Request(name, c, Future(), deadline_ms)
+            reqs = [_Request(name, c, Future(), deadline_ms,
+                             trace_id=trace_id)
                     for c in chunks]
             enqueued = []
             try:
@@ -836,6 +868,7 @@ class Server:
         if shed:
             _telemetry.counter("serving.shed_requests").inc()
             _telemetry.counter("serving.shed_requests.%s" % req.model).inc()
+            _obs.log_access(req.model, "shed", request_id=req.trace_id)
             raise ServerOverloadedError(
                 "server overloaded: %d request(s) already pending "
                 "(serving.max_pending=%d); request shed — back off and "
@@ -856,9 +889,17 @@ class Server:
                 removed.append(req)
             if removed:
                 _telemetry.gauge("serving.pending").set(len(self._pending))
+        outcome = _access_outcome(exc)
         for req in removed:
             if not req.future.done():
                 req.future.set_exception(exc)
+                if _obs.access_log_enabled():
+                    _obs.log_access(
+                        req.model, outcome, request_id=req.trace_id,
+                        queue_ms=(_time.perf_counter() - req.t_submit)
+                        * 1e3,
+                        error="%s: %s" % (type(exc).__name__, exc)
+                        if outcome == "error" else None)
         return removed
 
     def predict(self, name, data, timeout=None, deadline_ms=None):
@@ -956,11 +997,13 @@ class Server:
         for req in reqs:
             self._count_deadline_exceeded(req.model)
             if not req.future.done():
+                queued_ms = (_time.perf_counter() - req.t_submit) * 1e3
                 req.future.set_exception(DeadlineExceededError(
                     "request for model %r %s (queued %.1fms, deadline "
-                    "passed)" % (req.model, reason,
-                                 (_time.perf_counter() - req.t_submit)
-                                 * 1e3)))
+                    "passed)" % (req.model, reason, queued_ms)))
+                _obs.log_access(req.model, "deadline",
+                                request_id=req.trace_id,
+                                queue_ms=queued_ms)
 
     def _supervise(self):
         """Batcher supervisor (the thread target): runs ``_loop`` under
@@ -983,6 +1026,10 @@ class Server:
             for req in pending:
                 if not req.future.done():
                     req.future.set_exception(cause)
+                    _obs.log_access(req.model, "error",
+                                    request_id=req.trace_id,
+                                    error="%s: %s"
+                                    % (type(cause).__name__, cause))
             _LOG.error(
                 "serving: batcher crashed and exhausted its restart "
                 "budget (%s: %s); all submits now fail fast — recreate "
@@ -1012,6 +1059,10 @@ class Server:
             for req in pending:
                 if not req.future.done():
                     req.future.set_exception(exc)
+                    _obs.log_access(req.model, "error",
+                                    request_id=req.trace_id,
+                                    error="%s: %s"
+                                    % (type(exc).__name__, exc))
             _LOG.warning(
                 "serving: batcher thread crashed (%s: %s); %d pending "
                 "future(s) failed with the causal exception; restarting "
@@ -1095,6 +1146,9 @@ class Server:
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)
+                    _obs.log_access(req.model, "breaker",
+                                    request_id=req.trace_id,
+                                    queue_ms=(t0 - req.t_submit) * 1e3)
             with self._cond:
                 self._last_dispatch_done = _time.perf_counter()
             return
@@ -1124,19 +1178,33 @@ class Server:
             _telemetry.counter("serving.dispatch_errors").inc()
             if breaker is not None:
                 breaker.record_failure()
+            err = "%s: %s" % (type(exc).__name__, exc)
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)
+                    _obs.log_access(req.model, "error",
+                                    request_id=req.trace_id,
+                                    queue_ms=(t0 - req.t_submit) * 1e3,
+                                    error=err)
             with self._cond:
                 self._last_dispatch_done = _time.perf_counter()
             return
         if breaker is not None:
             breaker.record_success()
         t1 = _time.perf_counter()
+        access_on = _obs.access_log_enabled()
+        row_nbytes = host.nbytes // max(1, host.shape[0]) if access_on \
+            else 0
         ofs = 0
         for req in batch:
             if not req.future.done():
                 req.future.set_result(host[ofs:ofs + req.rows])
+                if access_on:
+                    _obs.log_access(req.model, "ok",
+                                    request_id=req.trace_id,
+                                    queue_ms=(t0 - req.t_submit) * 1e3,
+                                    dispatch_ms=(t1 - t0) * 1e3,
+                                    bytes=req.rows * row_nbytes)
             ofs += req.rows
             _telemetry.timer("serving.request_ms").observe(
                 (t1 - req.t_submit) * 1e3)
@@ -1201,6 +1269,57 @@ class Server:
                                       and thread.is_alive()),
                 "open_requests": open_reqs,
                 "breakers": breakers}
+
+    def _health(self):
+        """mx.obs health source (registered in :meth:`start`): the
+        ``/healthz`` slice of this server — batcher liveness, per-model
+        breaker state, per-engine decode-loop liveness and KV-pool
+        saturation.  KV saturation is reported but does NOT flip
+        ``healthy`` (transient pool exhaustion under load is expected
+        back-pressure, not an outage)."""
+        with self._cond:
+            breakers = {name: e.breaker.state if e.breaker is not None
+                        else "closed"
+                        for name, e in self._models.items()}
+            batcher_dead = self._batcher_dead
+            started = self._started
+            thread = self._thread
+            pending = len(self._pending)
+            engines = dict(self._generation)
+        reasons = []
+        if batcher_dead is not None:
+            reasons.append("batcher_dead")
+        batcher_alive = bool(thread is not None and thread.is_alive())
+        if started and not batcher_alive:
+            reasons.append("batcher_thread_dead")
+        for name, state in breakers.items():
+            if state == "open":
+                reasons.append("breaker_open:%s" % name)
+        generation = {}
+        for name, eng in engines.items():
+            s = eng.stats()
+            if started and not s["engine_alive"]:
+                reasons.append("engine_dead:%s" % name)
+            if s["breaker"] == "open":
+                reasons.append("breaker_open:%s" % name)
+            generation[name] = {
+                "engine_alive": s["engine_alive"],
+                "breaker": s["breaker"],
+                "queued": s["queued"],
+                "active": s["active"],
+                "kv_pages": s["kv_pages"],
+                "kv_pages_free": s["kv_pages_free"],
+                "kv_saturated": s["kv_pages_free"] == 0,
+            }
+        return {
+            "healthy": not reasons,
+            "reasons": reasons,
+            "started": started,
+            "pending": pending,
+            "batcher_alive": batcher_alive,
+            "breakers": breakers,
+            "generation": generation,
+        }
 
     # ------------------------------------------------------------- stats
     def stats(self):

@@ -9,7 +9,9 @@ This module is that one place for the TPU port:
 
   * a thread-safe METRICS REGISTRY — ``counter(name)`` (monotonic, atomic
     increments), ``gauge(name)`` (last-value), ``timer(name)`` (histogram
-    with count/total/min/max/p50/p99 over a bounded sample reservoir).  The
+    with count/total/min/max/p50/p99 over a bounded sample reservoir, plus
+    ``p50_1m``/``p99_1m`` over a rotating two-epoch time window so live
+    quantiles track CURRENT traffic, not since-boot history).  The
     hot-path seams (Module/SPMDTrainer/gluon.Trainer steps, Executor eager
     replays, io batch fetch, kvstore push/pull) feed it unconditionally —
     one perf_counter pair and one lock per observation, noise-level next to
@@ -131,11 +133,22 @@ class Gauge:
 class Timer:
     """Duration histogram: exact count/total/min/max plus p50/p99 from a
     bounded reservoir of the most recent observations (the aggregate_stats
-    table columns, extended with the percentiles monitor never had)."""
+    table columns, extended with the percentiles monitor never had).
 
-    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_lock")
+    Alongside the lifetime reservoir, a rotating TWO-EPOCH time window
+    (``WINDOW_S``, default 60s, split into two half-window epochs) feeds the
+    ``p50_1m``/``p99_1m`` keys of :meth:`stats`: observations land in the
+    current epoch, and at most one timestamp compare per observation rotates
+    current→previous when the half-window elapses.  The windowed quantiles
+    merge both epochs, so they always cover between WINDOW_S/2 and WINDOW_S
+    of recent history and a warmup burst ages out of them within a minute
+    instead of polluting the quantiles for the life of the process."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_samples",
+                 "_win_start", "_win_cur", "_win_prev", "_lock")
 
     MAX_SAMPLES = 2048  # ring buffer bound: percentiles track the recent run
+    WINDOW_S = 60.0     # two-epoch window span for the p50_1m/p99_1m keys
 
     def __init__(self, name):
         self.name = name
@@ -144,10 +157,31 @@ class Timer:
         self.min = None     # guarded-by: _lock
         self.max = None     # guarded-by: _lock
         self._samples = deque(maxlen=self.MAX_SAMPLES)  # guarded-by: _lock
+        self._win_start = time.monotonic()  # guarded-by: _lock
+        self._win_cur = deque(maxlen=self.MAX_SAMPLES)   # guarded-by: _lock
+        self._win_prev = deque(maxlen=self.MAX_SAMPLES)  # guarded-by: _lock
         self._lock = threading.Lock()
 
-    def observe(self, seconds):
+    def _rotate_locked(self, now):  # mxlint: holds(_lock)
+        half = self.WINDOW_S / 2.0
+        lag = now - self._win_start
+        if lag < half:
+            return
+        if lag >= 2.0 * half:
+            # an idle gap swallowed both epochs: everything in the window
+            # is stale, start fresh
+            self._win_prev = deque(maxlen=self.MAX_SAMPLES)
+            self._win_cur = deque(maxlen=self.MAX_SAMPLES)
+            self._win_start = now
+        else:
+            self._win_prev = self._win_cur
+            self._win_cur = deque(maxlen=self.MAX_SAMPLES)
+            self._win_start += half
+
+    def observe(self, seconds, now=None):
         seconds = float(seconds)
+        if now is None:
+            now = time.monotonic()
         with self._lock:
             self.count += 1
             self.total += seconds
@@ -156,6 +190,8 @@ class Timer:
             if self.max is None or seconds > self.max:
                 self.max = seconds
             self._samples.append(seconds)
+            self._rotate_locked(now)
+            self._win_cur.append(seconds)
 
     class _Span:
         __slots__ = ("_timer", "_t0")
@@ -175,30 +211,48 @@ class Timer:
         return Timer._Span(self)
 
     def percentile(self, p):
+        # copy under the lock, sort OUTSIDE it: an O(n log n) sort inside
+        # the lock stalls every in-flight timer.time() scope behind a
+        # reader (the snapshot/observe contention the 8-thread stress test
+        # in tests/test_telemetry.py exercises)
         with self._lock:
-            samples = sorted(self._samples)
+            samples = list(self._samples)
+        samples.sort()
         if not samples:
             return None
         idx = max(0, min(len(samples) - 1,
                          int(round(p / 100.0 * (len(samples) - 1)))))
         return samples[idx]
 
-    def stats(self):
+    def stats(self, now=None):
+        if now is None:
+            now = time.monotonic()
+        # one lock acquisition reads every field, so a concurrent observe()
+        # or reset() can never tear the dict (count from before a reset,
+        # total from after); sorting happens outside the lock on copies
         with self._lock:
             count, total = self.count, self.total
             mn, mx = self.min, self.max
-            samples = sorted(self._samples)
+            samples = list(self._samples)
+            self._rotate_locked(now)
+            win = list(self._win_cur) + list(self._win_prev)
+        samples.sort()
+        win.sort()
 
-        def pct(p):
-            if not samples:
+        def pct(vals, p):
+            if not vals:
                 return None
-            i = max(0, min(len(samples) - 1,
-                           int(round(p / 100.0 * (len(samples) - 1)))))
-            return samples[i]
+            i = max(0, min(len(vals) - 1,
+                           int(round(p / 100.0 * (len(vals) - 1)))))
+            return vals[i]
 
         return {"count": count, "total": total,
                 "min": mn or 0.0, "max": mx or 0.0,
-                "p50": pct(50) or 0.0, "p99": pct(99) or 0.0}
+                "p50": pct(samples, 50) or 0.0,
+                "p99": pct(samples, 99) or 0.0,
+                "count_1m": len(win),
+                "p50_1m": pct(win, 50) or 0.0,
+                "p99_1m": pct(win, 99) or 0.0}
 
     def reset(self):
         with self._lock:
@@ -207,6 +261,9 @@ class Timer:
             self.min = None
             self.max = None
             self._samples.clear()
+            self._win_start = time.monotonic()
+            self._win_cur.clear()
+            self._win_prev.clear()
 
 
 def _get_or_create(table, cls, name):
@@ -234,7 +291,7 @@ def timer(name):
 def snapshot():
     """Point-in-time view of the whole registry:
     ``{"counters": {name: int}, "gauges": {name: value},
-    "timers": {name: {count,total,min,max,p50,p99}}}``."""
+    "timers": {name: {count,total,min,max,p50,p99,p50_1m,p99_1m}}}``."""
     with _REGISTRY_LOCK:
         counters = list(_COUNTERS.values())
         gauges = list(_GAUGES.values())
@@ -524,3 +581,9 @@ from . import resilience as _resilience  # noqa: E402,F401
 # mx.perf registers the step hook above and honors MXNET_TPU_PROFILE at
 # its import, so any training-path import arms cost attribution
 from . import perf as _perf  # noqa: E402,F401
+
+# mx.obs (the operational plane) honors MXNET_TPU_OBS_LISTEN /
+# MXNET_TPU_OBS_ACCESS_LOG / MXNET_TPU_OBS_SLO at ITS import — pulled in
+# here so any training/serving-path import can bring the exporter up from
+# the environment alone (it reads this registry; stdlib-only, no jax)
+from . import obs as _obs  # noqa: E402,F401

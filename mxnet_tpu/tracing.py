@@ -52,7 +52,8 @@ __all__ = ["span", "current_span", "wrap_context", "configure_sink",
            "open_spans", "ring_events", "record_event", "notify_step",
            "dump_watchdog_report", "load_trace", "validate_trace_events",
            "validate_watchdog_report", "register_stall_probe",
-           "unregister_stall_probe", "check_stall_probes", "Span"]
+           "unregister_stall_probe", "check_stall_probes",
+           "last_step_age_s", "Span"]
 
 # ------------------------------------------------------------- span context
 #: the active span for the calling context.  contextvars (not thread-local)
@@ -392,6 +393,13 @@ def check_stall_probes(interval_s):
         if info:
             stalls[name] = info
     return stalls
+
+
+def last_step_age_s():
+    """Seconds since the last completed train step (any source) — the
+    watchdog's hang-age signal, exposed for the mx.obs ``/healthz``
+    endpoint.  Measured from process start until the first step."""
+    return time.perf_counter() - _LAST_PROGRESS[0]
 
 
 def notify_step(source, step, wall_s, error=None):

@@ -172,4 +172,4 @@ def test_check_generation_smoke():
         len(report["compiles"]["prompt_buckets"]) + \
         len(report["compiles"]["decode_widths"])
     assert report["kv_pool"]["exhausted_waits"] > 0
-    assert report["elapsed_s"] < 5.0, report
+    assert report["elapsed_s"] < (5.0 if (os.cpu_count() or 1) >= 2 else 10.0), report

@@ -74,6 +74,76 @@ def test_timer_stats_and_reservoir():
     assert t.stats()["count"] == 101
 
 
+def test_timer_windowed_quantiles_rotate():
+    t = telemetry.timer("t.window")
+    base = t._win_start
+    t.observe(0.100, now=base)               # epoch A
+    s = t.stats(now=base)
+    assert s["count_1m"] == 1 and s["p99_1m"] == 0.100
+    t.observe(0.001, now=base + 31.0)        # epoch B (A rotated to prev)
+    s = t.stats(now=base + 31.0)
+    assert s["count_1m"] == 2                # window spans both epochs
+    assert s["p50_1m"] == 0.001 and s["p99_1m"] == 0.100
+    s = t.stats(now=base + 61.0)             # A aged out, B survives
+    assert s["count_1m"] == 1 and s["p99_1m"] == 0.001
+    s = t.stats(now=base + 200.0)            # idle gap: whole window stale
+    assert s["count_1m"] == 0 and s["p99_1m"] == 0.0
+    assert s["count"] == 2                   # lifetime view untouched
+    assert s["p99"] == 0.100
+
+
+def test_timer_stress_concurrent_observe_snapshot_reset():
+    """8 threads hammering observe/stats/snapshot/reset concurrently:
+    no exceptions, and every read is a CONSISTENT view (never a torn
+    count-without-total or a min above max)."""
+    t = telemetry.timer("t.stress")
+    stop = threading.Event()
+    errors = []
+
+    def observer():
+        try:
+            while not stop.is_set():
+                t.observe(0.002)
+                with t.time():
+                    pass
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                s = t.stats()
+                assert (s["count"] == 0) == (s["total"] == 0.0), s
+                if s["count"]:
+                    assert s["min"] <= s["max"], s
+                    assert s["p50"] <= s["p99"], s
+                    assert s["p50_1m"] <= s["p99_1m"], s
+                assert s["count_1m"] <= 2 * telemetry.Timer.MAX_SAMPLES
+                telemetry.snapshot()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def resetter():
+        try:
+            while not stop.is_set():
+                telemetry.reset()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=fn) for fn in
+               (observer, observer, observer, observer,
+                reader, reader, reader, resetter)]
+    for th in threads:
+        th.start()
+    stop_at = threading.Timer(1.0, stop.set)
+    stop_at.start()
+    for th in threads:
+        th.join(timeout=30)
+    stop_at.cancel()
+    assert not any(th.is_alive() for th in threads)
+    assert not errors, errors[0]
+
+
 def test_gauge_and_snapshot_dispatch_superset():
     telemetry.gauge("t.depth").set(5)
     snap = telemetry.snapshot()

@@ -380,4 +380,4 @@ def test_check_tracing_smoke():
     assert report["ok"], report
     assert report["trace"]["steps"] == 3, report
     assert report["report"]["open_spans"] >= 1, report
-    assert report["elapsed_s"] < 2.0, report
+    assert report["elapsed_s"] < (2.0 if (os.cpu_count() or 1) >= 2 else 4.0), report

@@ -35,6 +35,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 BATCH = 8
+# A single-core runner pays every XLA compile serially; the
+# budget calibrated for the normal >=2-core CI box doubles there.
+BUDGET_S = 5.0 if (os.cpu_count() or 1) >= 2 else 10.0
 ROWS = 28          # 3 full batches + a 4-row ragged tail
 FEATURES = 6
 SEED = 11
@@ -212,8 +215,9 @@ def main():
         result["decode"] = {"batches": len(pooled), "retries": retried}
 
         result["elapsed_s"] = round(time.perf_counter() - t_main, 3)
-        assert result["elapsed_s"] < 5.0, \
-            "smoke exceeded the 5s budget: %.3fs" % result["elapsed_s"]
+        assert result["elapsed_s"] < BUDGET_S, \
+            "smoke exceeded the %.0fs budget: %.3fs" \
+            % (BUDGET_S, result["elapsed_s"])
         result["ok"] = True
     except Exception as exc:  # noqa: BLE001 — the JSON line IS the report
         result["error"] = "%s: %s" % (type(exc).__name__, exc)

@@ -39,7 +39,9 @@ STEPS = 10
 NAN_STEP = 5
 PREEMPT_AFTER = 7  # SIGTERM lands before this step; exit happens after it
 CKPT_EVERY = 2
-BUDGET_S = 5.0
+# A single-core runner pays every XLA compile serially; the
+# budget calibrated for the normal >=2-core CI box doubles there.
+BUDGET_S = 5.0 if (os.cpu_count() or 1) >= 2 else 10.0
 
 
 def make_batches(np):

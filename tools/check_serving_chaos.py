@@ -44,7 +44,9 @@ sys.path.insert(0, ROOT)
 MAX_BATCH = 8
 FEATURES = 6
 COOLDOWN_MS = 150.0
-BUDGET_S = 5.0
+# A single-core runner pays every XLA compile serially; the
+# budget calibrated for the normal >=2-core CI box doubles there.
+BUDGET_S = 5.0 if (os.cpu_count() or 1) >= 2 else 10.0
 
 
 def main():

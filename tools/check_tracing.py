@@ -33,6 +33,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 
+# A single-core runner pays every XLA compile serially; the
+# budget calibrated for the normal >=2-core CI box doubles there.
+BUDGET_S = 2.0 if (os.cpu_count() or 1) >= 2 else 4.0
+
 STEPS = 3
 WD_DEADLINE = 0.15
 STALL_TIMEOUT = 2.0
@@ -173,8 +177,9 @@ def main():
         result["merge"] = {"events": len(merged), "planes": sorted(pids)}
 
         result["elapsed_s"] = round(time.perf_counter() - t_main, 3)
-        assert result["elapsed_s"] < 2.0, \
-            "smoke exceeded the 2s budget: %.3fs" % result["elapsed_s"]
+        assert result["elapsed_s"] < BUDGET_S, \
+            "smoke exceeded the %.0fs budget: %.3fs" \
+            % (BUDGET_S, result["elapsed_s"])
         result["ok"] = True
     except Exception as exc:  # noqa: BLE001 — the JSON line IS the report
         result["error"] = "%s: %s" % (type(exc).__name__, exc)

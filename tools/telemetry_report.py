@@ -43,6 +43,19 @@ breaker state at the last dispatch, buckets hit — plus the anomalies:
     shed) were rejected by admission control across >= 10 dispatches:
     sustained overload, not a blip the bounded queue absorbed.
 
+``access`` records (one per request terminal outcome, written by the
+mx.obs access log — ``MXNET_TPU_OBS_ACCESS_LOG=jsonl:<path>``; the file
+can be fed here directly or concatenated onto a step log) get a
+per-model availability table — outcome tally, error rate, latency
+percentiles — plus:
+
+  * SLO budget burn — the log's error rate (outcome != ok) consumes the
+    availability error budget (``--slo``, default 99.9%) at more than
+    1x across >= 10 requests: at this rate the budget is exhausted
+    before the SLO window ends.  Burn > 1 sustained is the
+    page-worthy signal (the live multi-window version runs in
+    mx.obs.SLOTracker; this is the offline mirror).
+
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # tables + flags
   python tools/telemetry_report.py RUN.jsonl --json   # machine-readable
@@ -63,6 +76,8 @@ QUEUE_DELAY_RATIO = 3.0  # serving p99 queue delay vs the configured budget
 SHED_RATIO = 0.10        # shed / offered load before overload is flagged
 MFU_COLLAPSE = 0.5       # late-window MFU median vs the run's own early one
 POOL_WAIT_RATIO = 0.10   # generation requests that stalled on KV pages
+SLO_AVAILABILITY = 99.9  # default --slo availability objective (percent)
+SLO_BURN = 1.0           # error-budget burn rate before the flag trips
 
 
 def load_records(path):
@@ -247,18 +262,67 @@ def _summarize_generation(gen_recs, anomalies):
     return tables
 
 
-def summarize(records):
+def _summarize_access(access_recs, anomalies, availability):
+    """Per-model availability table over mx.obs ``access`` records,
+    appending the ``slo_budget_burn`` anomaly in place.  ``availability``
+    is the SLO objective in percent (e.g. 99.9); the budget is its
+    complement and burn is the log's error rate over that budget."""
+    budget = max(1e-9, 1.0 - availability / 100.0)
+    by_model = {}
+    for r in access_recs:
+        by_model.setdefault(r.get("model", "?"), []).append(r)
+    tables = {}
+    for model in sorted(by_model):
+        recs = by_model[model]
+        outcomes = {}
+        for r in recs:
+            o = r.get("outcome", "?")
+            outcomes[o] = outcomes.get(o, 0) + 1
+        errors = len(recs) - outcomes.get("ok", 0)
+        rate = errors / float(len(recs))
+        burn = rate / budget
+        queues = sorted(float(r["queue_ms"]) for r in recs
+                        if isinstance(r.get("queue_ms"), (int, float)))
+        walls = sorted(float(r["dispatch_ms"]) for r in recs
+                       if isinstance(r.get("dispatch_ms"), (int, float)))
+        q_p99 = _pct(queues, 99)
+        w_p99 = _pct(walls, 99)
+        tables[model] = {
+            "requests": len(recs),
+            "outcomes": outcomes,
+            "errors": errors,
+            "error_rate": round(rate, 6),
+            "burn_rate": round(burn, 3),
+            "queue_ms_p99": round(q_p99, 3) if q_p99 is not None else None,
+            "dispatch_ms_p99": round(w_p99, 3)
+            if w_p99 is not None else None,
+        }
+        if len(recs) >= MIN_STEPS_FOR_FLAGS and burn > SLO_BURN:
+            anomalies.append({
+                "kind": "slo_budget_burn", "source": model,
+                "detail": "error rate %.4f%% burns the %.9g%% "
+                          "availability budget at %.1fx over %d requests "
+                          "(outcomes: %s): budget exhausts before the "
+                          "SLO window ends"
+                          % (100.0 * rate, availability, burn, len(recs),
+                             ", ".join("%s=%d" % kv for kv in
+                                       sorted(outcomes.items())))})
+    return tables
+
+
+def summarize(records, slo_availability=SLO_AVAILABILITY):
     """Reduce parsed records to {"sources": {name: table}, "serving":
-    {model: table}, "anomalies": [...], "monitor_events": int,
-    "other_events": int}.  Used by the CLI and by
+    {model: table}, "access": {model: table}, "anomalies": [...],
+    "monitor_events": int, "other_events": int}.  Used by the CLI and by
     tools/check_telemetry.py's no-anomalies assertion."""
     steps = [r for r in records if r.get("event") == "step"]
     serving_recs = [r for r in records if r.get("event") == "serving"]
     gen_recs = [r for r in records
                 if r.get("event") == "serving_generate"]
+    access_recs = [r for r in records if r.get("event") == "access"]
     monitor_events = sum(1 for r in records if r.get("event") == "monitor")
     other = len(records) - len(steps) - len(serving_recs) \
-        - len(gen_recs) - monitor_events
+        - len(gen_recs) - len(access_recs) - monitor_events
 
     sources = {}
     anomalies = []
@@ -372,8 +436,10 @@ def summarize(records):
 
     serving = _summarize_serving(serving_recs, anomalies)
     generation = _summarize_generation(gen_recs, anomalies)
+    access = _summarize_access(access_recs, anomalies, slo_availability)
     return {"sources": sources, "serving": serving,
-            "generation": generation, "anomalies": anomalies,
+            "generation": generation, "access": access,
+            "anomalies": anomalies,
             "monitor_events": monitor_events, "other_events": other}
 
 
@@ -443,6 +509,22 @@ def render(summary, bad_lines=0):
                             _fmt(t["ttft_ms_p99"]),
                             _fmt(t["tokens_per_s"]), t["pool_waits"],
                             t.get("breaker") or "-"))
+    access = summary.get("access") or {}
+    if access:
+        lines.append("")
+        ahdr = ("%-10s %9s %8s %11s %6s %10s %12s %s"
+                % ("model", "requests", "errors", "error_rate", "burn",
+                   "qd_p99ms", "disp_p99ms", "outcomes"))
+        lines.append(ahdr)
+        lines.append("-" * len(ahdr))
+        for model, t in access.items():
+            lines.append("%-10s %9d %8d %11s %6s %10s %12s %s"
+                         % (model, t["requests"], t["errors"],
+                            _fmt(t["error_rate"]), _fmt(t["burn_rate"]),
+                            _fmt(t["queue_ms_p99"]),
+                            _fmt(t["dispatch_ms_p99"]),
+                            ", ".join("%s=%d" % kv for kv in
+                                      sorted(t["outcomes"].items()))))
     if summary["monitor_events"]:
         lines.append("monitor events: %d" % summary["monitor_events"])
     if summary["other_events"]:
@@ -468,10 +550,14 @@ def main(argv=None):
                     help="emit the summary as one JSON object")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 when any anomaly is flagged (CI gate)")
+    ap.add_argument("--slo", type=float, default=SLO_AVAILABILITY,
+                    metavar="PCT",
+                    help="availability objective for the access-record "
+                         "budget-burn flag (default %(default)s)")
     args = ap.parse_args(argv)
 
     records, bad = load_records(args.log)
-    summary = summarize(records)
+    summary = summarize(records, slo_availability=args.slo)
     if args.json:
         summary["malformed_lines"] = bad
         print(json.dumps(summary))
