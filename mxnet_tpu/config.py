@@ -28,6 +28,14 @@ _KNOBS = {}
 _OVERRIDES = {}
 _ON_SET = {}  # knob name -> callback(value), fired after set()
 
+# Knobs that never bump the cache epoch on change.  Everything these knobs
+# influence is either pure host-side state or threaded into program-cache
+# keys as its OWN key element (numerics.capture's variant token), so both
+# knob states coexist in the caches and a toggle must not evict compiled
+# programs.  Side-effect hooks still fire.
+_EPOCH_NEUTRAL = {"numerics.capture", "quant.drift_every",
+                  "quant.drift_threshold"}
+
 
 def register_knob(name, env, type_, default, doc):
     """Declare a knob.  `env` is its environment variable; `type_` one of
@@ -88,8 +96,9 @@ def set(name, value):  # noqa: A001 — reference-parity name
             hook(parsed)
         return
     _OVERRIDES[name] = parsed
-    global _EPOCH
-    _EPOCH += 1
+    if name not in _EPOCH_NEUTRAL:
+        global _EPOCH
+        _EPOCH += 1
     if hook is not None:
         hook(parsed)
 
@@ -110,8 +119,9 @@ def unset(name):
     new = get(name)
     if new == old:
         return
-    global _EPOCH
-    _EPOCH += 1
+    if name not in _EPOCH_NEUTRAL:
+        global _EPOCH
+        _EPOCH += 1
     hook = _ON_SET.get(name)
     if hook is not None:
         hook(new)
@@ -564,6 +574,62 @@ def _apply_quant_calib_mode(value):
 
 
 _ON_SET["quant.calib_mode"] = _apply_quant_calib_mode
+
+# numerics plane (docs/OBSERVABILITY.md "Numerics plane")
+register_knob(
+    "numerics.capture", "MXNET_TPU_NUMERICS", str, "",
+    "in-program tensor-statistics capture cadence: 'step:N' makes each "
+    "step seam (module fused step, SPMDTrainer, gluon Trainer) run its "
+    "stats-instrumented program variant every Nth step, riding per-site "
+    "amax/amin/rms/non-finite/bf16-saturation summaries out as an extra "
+    "side-output pytree (mx.numerics; zero happy-path host sync — stats "
+    "drain through the is-ready poll). Empty/'off' (default) disables: "
+    "lowered step programs stay byte-identical to a build without taps. "
+    "Epoch-NEUTRAL: the instrumented variant is its own program-cache "
+    "entry, so toggling never evicts compiled steps.")
+register_knob(
+    "quant.drift_every", "MXNET_TPU_QUANT_DRIFT_EVERY", int, 0,
+    "quantization drift sampling: every Nth quantized mx.serving "
+    "dispatch also runs the artifact's stats-twin program over the same "
+    "batch and folds each site's runtime |max| into an EWMA against the "
+    "calibration manifest (quant.drift_ratio.<model>.<site> gauges on "
+    "/metrics; a quant_drift JSONL event fires past "
+    "quant.drift_threshold). 0 (default) disables sampling.")
+register_knob(
+    "quant.drift_threshold", "MXNET_TPU_QUANT_DRIFT_THRESHOLD", float, 1.5,
+    "drift alarm bound: a quantized site whose smoothed runtime-amax / "
+    "calibrated-amax ratio exceeds this is counted drifted (ratio 1.0 = "
+    "exactly the calibrated range; int8 saturates above it).")
+
+
+def _apply_numerics_capture(value):
+    from . import numerics
+    try:
+        numerics.configure(value)
+    except ValueError:
+        # reject at set() time and revert (the nanguard pattern): a typo'd
+        # cadence must not linger as the stored override
+        _OVERRIDES.pop("numerics.capture", None)
+        raise
+
+
+def _apply_quant_drift_every(value):
+    if int(value) < 0:
+        _OVERRIDES.pop("quant.drift_every", None)
+        raise ValueError("quant.drift_every must be >= 0, got %r"
+                         % (value,))
+
+
+def _apply_quant_drift_threshold(value):
+    if float(value) <= 0:
+        _OVERRIDES.pop("quant.drift_threshold", None)
+        raise ValueError("quant.drift_threshold must be > 0, got %r"
+                         % (value,))
+
+
+_ON_SET["numerics.capture"] = _apply_numerics_capture
+_ON_SET["quant.drift_every"] = _apply_quant_drift_every
+_ON_SET["quant.drift_threshold"] = _apply_quant_drift_threshold
 
 # inference serving (docs/SERVING.md)
 register_knob(

@@ -255,6 +255,25 @@ class Trainer:
         if self._kvstore and self._update_on_kvstore:
             return
         from .. import resilience as _resilience
+        from .. import numerics as _numerics
+        self._numerics_t = getattr(self, "_numerics_t", 0) + 1
+        cap_stats = _numerics.should_capture("gluon")
+        stats = {} if cap_stats else None
+        if _resilience.nanguard_mode():
+            # forensics replay for the eager path: per-grad stats over the
+            # live grad buffers (the failing step's — the updater loop is
+            # skipped on a non-finite step and the abort fires before the
+            # next backward overwrites them)
+            def _replay(params=self._params):
+                sink = {}
+                for p in params:
+                    if p.grad_req == "null":
+                        continue
+                    data = getattr(p.grad(), "_data", None)
+                    if data is not None:
+                        _numerics.record(sink, "grad." + p.name, data)
+                return sink
+            _numerics.hold_replay("gluon", _replay)
         if _resilience.nanguard_mode():
             # autograd-eager path: one host sync per step is the cost of
             # running unfused (the fused paths check on-device)
@@ -285,7 +304,16 @@ class Trainer:
                 from ..ndarray.sparse import RowSparseNDArray, dense_to_sparse
                 if not isinstance(grad, RowSparseNDArray):
                     grad = dense_to_sparse(grad, "row_sparse")
+            if stats is not None:
+                gd = getattr(grad, "_data", None)
+                if gd is not None:
+                    _numerics.record(stats, "grad." + param.name, gd)
             updater(i, grad, param.data())
+            if stats is not None:
+                _numerics.record(stats, "update." + param.name,
+                                 param.data()._data)
+        if stats:
+            _numerics.publish("gluon", self._numerics_t, stats)
 
     def save_states(self, fname):
         """Saves trainer (optimizer) states to a file

@@ -176,6 +176,18 @@ class TransformerLM:
         final norm.  Used by apply() and by models embedding differently
         before the stack (models/bert.py)."""
         x = self._constrain(x, self._dp, self._sp, None)
+        from .. import numerics as _numerics
+        if _numerics.collecting():
+            # per-layer stats ride the scan as ys, so scan-over-layers
+            # still compiles the layer body once; the (L, 6) stack is
+            # expanded to layer_out[i] sites host-side
+            def body(carry, lp):
+                out = self._layer(carry, lp)
+                return out, _numerics.summarize(out)
+
+            x, ys = _runtime.scan_stack(body, x, params["layers"])
+            _numerics.tap_stacked("layer_out", ys)
+            return _norm(x, params["final_norm"])
 
         def body(carry, lp):
             return self._layer(carry, lp), None

@@ -120,13 +120,22 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
         if validation_metric is None:
             validation_metric = eval_metric
+        if monitor is not None and getattr(self, "_exec", None) is not None:
+            # the reference installed the monitor on every executor at
+            # bind (base_module.py:499); this fit's `monitor=` arg was
+            # silently dead before PR 18
+            monitor.install(self._exec)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.train_step(data_batch)
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     from ..callback import BatchEndParam

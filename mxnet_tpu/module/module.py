@@ -93,6 +93,8 @@ class Module(BaseModule):
         # those share buffers with caller-owned NDArrays, which a donated
         # program would invalidate — the first step copies, then owns
         self._fused_owns_params = False
+        # one-time notice when an installed Monitor rides the fused path
+        self._warned_monitor_fused = False
 
     # ------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -237,9 +239,31 @@ class Module(BaseModule):
         if type(self) is not Module:
             # subclasses (SVRGModule) inspect grad_dict between stages
             return False
-        if self._inputs_need_grad or self._exec._placement \
-                or self._exec._monitor is not None:
+        if self._inputs_need_grad or self._exec._placement:
             return False
+        cb = self._exec._monitor
+        if cb is not None:
+            from ..monitor import Monitor
+            if not isinstance(getattr(cb, "__self__", None), Monitor):
+                # a RAW monitor callback wants every intermediate eagerly
+                # — only the stage-at-a-time executor materializes those
+                return False
+            # an mx.monitor.Monitor keeps working fused: outputs fire
+            # through its callback after the dispatch and toc() reads the
+            # written-back arg_dict; per-op intermediates come from the
+            # numerics capture knob instead of forcing the eager path
+            # (the pre-numerics behavior silently dropped 10-100x fused
+            # throughput the moment a monitor was installed)
+            if not self._warned_monitor_fused:
+                self._warned_monitor_fused = True
+                self.logger.warning(
+                    "Monitor installed on a FUSED module step: interval "
+                    "param/output stats keep working, but per-op "
+                    "intermediates are not materialized on this path — "
+                    "set numerics.capture=step:N (MXNET_TPU_NUMERICS) "
+                    "for in-program per-site statistics, or "
+                    "config.set('module.fused_step', 'off') for the "
+                    "reference eager monitor.")
         if not getattr(self._optimizer, "jit_safe", False):
             return False
         req = self._exec.grad_req
@@ -297,7 +321,12 @@ class Module(BaseModule):
                            if req.get(n, "null") != "null"))
         feed_sig = tuple((n, tuple(v.shape), str(v.dtype))
                          for n, v in sorted(feeds.items()))
-        fn = exec_.fused_step_fn(wrt, optimizer, feed_sig)
+        from .. import numerics as _numerics
+        # cadence decision per step: the instrumented program is a
+        # SEPARATE cache entry, so off-steps replay the plain program
+        # unchanged and toggling the knob never recompiles
+        cap = _numerics.should_capture("module")
+        fn = exec_.fused_step_fn(wrt, optimizer, feed_sig, instrument=cap)
         idxs = tuple(self._param_names.index(n) for n in wrt)
         # lazily materialize per-name optimizer state (create_state wants
         # the live weight for shape/dtype)
@@ -332,19 +361,54 @@ class Module(BaseModule):
                     if n not in opt_state and n not in feeds}
         key = _random.new_eager_seed_key()
         guard = _resilience.nanguard_mode()
+        stats = None
         if guard:
             streak = shared.get("nan_streak")
             if streak is None:
                 streak = jnp.zeros((), jnp.int32)
-            new_w, new_s, aux_updates, outs, shared["nan_streak"] = fn(
-                wrt_vals, opt_state, rest_env, feeds, key,
-                jnp.asarray(t, jnp.int32), lrs, wds, streak)
+            res = fn(wrt_vals, opt_state, rest_env, feeds, key,
+                     jnp.asarray(t, jnp.int32), lrs, wds, streak)
+            if cap:
+                new_w, new_s, aux_updates, outs, \
+                    shared["nan_streak"], stats = res
+            else:
+                new_w, new_s, aux_updates, outs, shared["nan_streak"] = res
             # no-sync host inspection of completed steps' streaks
             _resilience.watch_streak("module", shared["nan_streak"])
+
+            def _replay():
+                # nanguard forensics (mx.numerics): re-run THIS batch once
+                # through the instrumented variant.  Params/opt state are
+                # read live (last-good after select_tree) and COPIED so
+                # the replay's donation cannot invalidate the buffers the
+                # abort path still checkpoints; feeds/key/t/lrs/wds are
+                # the failing step's own.
+                import jax as _jax
+                fi = exec_.fused_step_fn(wrt, optimizer, feed_sig,
+                                         instrument=True)
+                wv = _jax.tree_util.tree_map(
+                    jnp.array, {n: exec_.arg_dict[n]._data for n in wrt})
+                st = _jax.tree_util.tree_map(
+                    jnp.array, {n: state[n] for n in wrt})
+                rest = {n: v for n, v in exec_._env().items()
+                        if n not in st and n not in feeds}
+                res = fi(wv, st, rest, feeds, key,
+                         jnp.asarray(t, jnp.int32), lrs, wds,
+                         jnp.zeros((), jnp.int32))
+                return res[-1]
+
+            _numerics.hold_replay("module", _replay)
         else:
-            new_w, new_s, aux_updates, outs = fn(
-                wrt_vals, opt_state, rest_env, feeds, key,
-                jnp.asarray(t, jnp.int32), lrs, wds)
+            res = fn(wrt_vals, opt_state, rest_env, feeds, key,
+                     jnp.asarray(t, jnp.int32), lrs, wds)
+            if cap:
+                new_w, new_s, aux_updates, outs, stats = res
+            else:
+                new_w, new_s, aux_updates, outs = res
+        if stats is not None:
+            # device stats land in the pending queue; the is-ready poll
+            # drains them later — no host sync on this thread
+            _numerics.publish("module", t, stats)
         for n in wrt:
             exec_.arg_dict[n]._data = new_w[n]
             state[n] = new_s[n]
@@ -352,6 +416,13 @@ class Module(BaseModule):
             if n in exec_.aux_dict:
                 exec_.aux_dict[n]._data = v
         exec_.outputs = [_wrap(o) for o in outs]
+        if exec_._monitor is not None:
+            # the fused path's Monitor contract (satellite of PR 18):
+            # outputs fire through the installed callback exactly like
+            # the eager executor's forward does
+            for name, arr in zip(self._symbol.list_outputs(),
+                                 exec_.outputs):
+                exec_._monitor(name, arr)
         self._fused_owns_params = True
         _profiler.counter_increment("fused_steps")
 

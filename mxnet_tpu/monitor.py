@@ -29,8 +29,29 @@ class Monitor:
         self.sort = sort
 
     def install(self, exe):
+        """Install this monitor's callback on ``exe``.  Reinstalling on
+        an executor this monitor already watches is a no-op (the
+        reference appended forever, so a bind/install loop leaked every
+        superseded executor through ``self.exes`` and ``toc`` kept
+        reporting their stale params)."""
         exe.set_monitor_callback(self._stat_helper)
-        self.exes.append(exe)
+        if not any(e is exe for e in self.exes):
+            self.exes.append(exe)
+
+    def uninstall(self, exe):
+        """Detach from ``exe``: clears its callback (when it is still
+        ours) and drops it from the stat sweep.  Unknown executors are
+        ignored."""
+        # bound-method EQUALITY, not identity: each `self._stat_helper`
+        # access builds a fresh bound-method object
+        if getattr(exe, "_monitor", None) == self._stat_helper:
+            exe.set_monitor_callback(None)
+        self.exes = [e for e in self.exes if e is not exe]
+
+    def uninstall_all(self):
+        """Detach from every installed executor."""
+        for exe in list(self.exes):
+            self.uninstall(exe)
 
     def _stat_helper(self, name, arr):
         if not self.activated or not self.re_prog.match(name):

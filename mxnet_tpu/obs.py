@@ -88,6 +88,11 @@ _PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 _LABELED_FAMILIES = ("serving.shed_requests", "serving.deadline_exceeded",
                      "serving.breaker_open", "serving.breaker_state")
 
+#: families whose trailing TWO dotted segments are ``<model>.<site>``
+#: (mx.numerics' quantization-drift gauges); site names carry no dots,
+#: so the split is on the LAST dot
+_LABELED_FAMILIES_2 = ("quant.drift_ratio",)
+
 
 def _prom_name(name):
     return _PROM_PREFIX + _PROM_BAD_CHARS.sub("_", name)
@@ -120,6 +125,11 @@ def _prom_value(value):
 
 
 def _split_family(name):
+    for base in _LABELED_FAMILIES_2:
+        if name.startswith(base + ".") and len(name) > len(base) + 1:
+            model, _, site = name[len(base) + 1:].rpartition(".")
+            if model and site:
+                return base, {"model": model, "site": site}
     for base in _LABELED_FAMILIES:
         if name.startswith(base + ".") and len(name) > len(base) + 1:
             return base, {"model": name[len(base) + 1:]}

@@ -231,6 +231,11 @@ class SparseLookupContext:
                              self._mesh if meta["axis"] else None,
                              meta["axis"])
         rows = rows + self._deltas[name].astype(rows.dtype)
+        from .. import numerics as _numerics
+        # fused-step trace opens a numerics collector when instrumented;
+        # the touched unique rows are the interesting tensor (the dense
+        # take() output just repeats them)
+        rows = _numerics.tap("embedding.%s.rows" % name, rows)
         self.records[name] = uniq
         return jnp.take(rows, inv, axis=0).reshape(
             tuple(ids.shape) + (shape[1],))
@@ -292,14 +297,17 @@ class ShardedEmbedding:
         self._progs = {}  # (kind, ids_shape, config-epoch) -> program
 
     # ------------------------------------------------------------ programs
-    def _prog(self, kind, ids_shape):
+    def _prog(self, kind, ids_shape, instrument=False):
         from .. import config as _config
+        from .. import numerics as _numerics
         # the programs bake in config-derived constants (unique_capacity
         # reads embedding.unique_size), so the config epoch is part of
         # the key and superseded entries are evicted — the same
-        # invalidation contract as symbol.py's key_sig
+        # invalidation contract as symbol.py's key_sig.  The numerics
+        # token is its own element: both variants coexist and toggling
+        # capture never evicts (the knob is epoch-neutral).
         epoch = _config.epoch()
-        key = (kind, ids_shape, epoch)
+        key = (kind, ids_shape, _numerics.capture_token(instrument), epoch)
         prog = self._progs.get(key)
         if prog is not None:
             return prog
@@ -318,6 +326,10 @@ class ShardedEmbedding:
                 rows = lookup_unique(table, uniq, mesh, self.axis)
                 out = jnp.take(rows, inv, axis=0).reshape(
                     tuple(ids.shape) + (self.dim,))
+                if instrument:
+                    from .. import numerics as _num
+                    return (out, jnp.sum(uniq < sentinel),
+                            {"embedding.rows": _num.summarize(rows)})
                 return out, jnp.sum(uniq < sentinel)
             prog = jax.jit(run)
         else:
@@ -334,7 +346,8 @@ class ShardedEmbedding:
         # (or eagerly) — cost registers, step MFU attribution stays with
         # the owning trainer's fused program
         prog = _perf.wrap(prog, "embedding",
-                          "%s/%s" % (kind, ids_shape))
+                          "%s/%s%s" % (kind, ids_shape,
+                                       "/numerics" if instrument else ""))
         self._progs[key] = prog
         return prog
 
@@ -347,14 +360,19 @@ class ShardedEmbedding:
         import time as _time
         from .. import telemetry as _telemetry
         from .. import tracing as _tracing
+        from .. import numerics as _numerics
         ids = jnp.asarray(ids)
+        cap_stats = _numerics.should_capture("embedding")
         with _tracing.span("embedding.lookup", cat="embedding"):
             t0 = _time.perf_counter()
-            out, n_unique = self._prog("lookup", tuple(ids.shape))(
-                self.table, ids)
+            res = self._prog("lookup", tuple(ids.shape),
+                             instrument=cap_stats)(self.table, ids)
+            out, n_unique = res[0], res[1]
             out.block_until_ready()
             _telemetry.timer("embedding.lookup_ms").observe(
                 (_time.perf_counter() - t0) * 1000.0)
+        if cap_stats:
+            _numerics.publish("embedding", self._t, res[2])
         n = max(int(ids.size), 1)
         _telemetry.counter("embedding.gathered_rows").inc(
             unique_capacity(n))

@@ -267,11 +267,12 @@ class SPMDTrainer:
         return sh
 
     # ------------------------------------------------------------ step build
-    def _build(self, pad=0):
+    def _build(self, pad=0, instrument=False):
         sparse_meta = {n: m for n, m in self._sparse_embed.items()
                        if n in self.fn.trainable}
         if sparse_meta:
-            return self._build_sparse(pad, sparse_meta)
+            return self._build_sparse(pad, sparse_meta, instrument)
+        from .. import numerics as _numerics
         masked = pad > 0
         fn = self.fn
         loss_fn = self.loss_fn
@@ -298,8 +299,18 @@ class SPMDTrainer:
                 param_map.update(train_params)
             prev = _nn_ops.set_hwio_weights(hwio)
             try:
-                (out,), new_aux = fn.apply(param_map, (data,), key,
-                                           training=True)
+                if instrument:
+                    # numerics variant: model-level tap sites (the scan-
+                    # carried transformer/BERT layer stats among them)
+                    # fill the collector at trace time and ride out
+                    # through the loss aux
+                    with _numerics.collect() as sink:
+                        (out,), new_aux = fn.apply(param_map, (data,), key,
+                                                   training=True)
+                    fstats = dict(sink)
+                else:
+                    (out,), new_aux = fn.apply(param_map, (data,), key,
+                                               training=True)
             finally:
                 _nn_ops.set_hwio_weights(prev)
             if cdt is not None:
@@ -308,6 +319,10 @@ class SPMDTrainer:
                 loss = _as_masked_scalar_loss(loss_fn, out, label, pad)
             else:
                 loss = _as_scalar_loss(loss_fn, out, label)
+            if instrument:
+                _numerics.record(fstats, "out", out)
+                _numerics.record(fstats, "loss", loss)
+                return loss, (new_aux, out, fstats)
             return loss, (new_aux, out)
 
         guard = self._guard_mode
@@ -318,9 +333,13 @@ class SPMDTrainer:
 
         def step(train_params, aux_params, opt_state, data, label, key, t,
                  lrs, wds, lr_scale, streak=None):
-            (loss, (new_aux, _)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_params, aux_params, data, label,
                                        key)
+            if instrument:
+                new_aux, _, stats = aux
+            else:
+                (new_aux, _), stats = aux, None
             new_params = {}
             new_state = {}
             from .. import random as _random
@@ -330,6 +349,8 @@ class SPMDTrainer:
             with _random.trace_key_scope(jax.random.fold_in(key, 1)):
                 for i, n in enumerate(trainable):
                     g = _preprocess(optimizer, grads[n])
+                    if stats is not None:
+                        _numerics.record(stats, "grad." + n, g)
                     if fused_opt and \
                             train_params[n].dtype == jnp.float32:
                         # fused Pallas epilogue: update + cast in one
@@ -346,9 +367,16 @@ class SPMDTrainer:
                                           wds[i], t)
                     new_params[n] = w.astype(train_params[n].dtype)
                     new_state[n] = s
+            if stats is not None:
+                # pre-guard candidate updates — on a bad step they SHOW
+                # the non-finite values forensics is after
+                for n in trainable:
+                    _numerics.record(stats, "update." + n, new_params[n])
             aux_out = dict(aux_params)
             aux_out.update(new_aux)
             if not guard:
+                if stats is not None:
+                    return new_params, aux_out, new_state, loss, stats
                 return new_params, aux_out, new_state, loss
             # nanguard (docs/RESILIENCE.md): all on-device — a bad step
             # keeps the pre-step params/state/aux (the update is computed
@@ -362,6 +390,9 @@ class SPMDTrainer:
                                                  train_params)
             new_state = _resilience.select_tree(finite, new_state, opt_state)
             aux_out = _resilience.select_tree(finite, aux_out, aux_params)
+            if stats is not None:
+                return (new_params, aux_out, new_state, loss, new_streak,
+                        stats)
             return new_params, aux_out, new_state, loss, new_streak
 
         # Sharding is carried by the arguments themselves (params were
@@ -374,7 +405,7 @@ class SPMDTrainer:
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _build_sparse(self, pad, sparse_meta):
+    def _build_sparse(self, pad, sparse_meta, instrument=False):
         """Fused step for models with sparse-grad embedding tables.
 
         Same program shape as `_build` (one donated jit: forward, backward,
@@ -395,6 +426,7 @@ class SPMDTrainer:
         compiled shapes — and ``fused_compiles`` — stay flat across ragged
         index batches padded to a common bucket.
         """
+        from .. import numerics as _numerics
         masked = pad > 0
         fn = self.fn
         loss_fn = self.loss_fn
@@ -435,8 +467,16 @@ class SPMDTrainer:
             prev = _nn_ops.set_hwio_weights(hwio)
             prev_ctx = _tensor_ops.set_embed_context(ctx)
             try:
-                (out,), new_aux = fn.apply(param_map, (data,), key,
-                                           training=True)
+                if instrument:
+                    # the touched-rows tap in SparseLookupContext.lookup
+                    # fires inside this collector too
+                    with _numerics.collect() as sink:
+                        (out,), new_aux = fn.apply(param_map, (data,), key,
+                                                   training=True)
+                    fstats = dict(sink)
+                else:
+                    (out,), new_aux = fn.apply(param_map, (data,), key,
+                                               training=True)
             finally:
                 _tensor_ops.set_embed_context(prev_ctx)
                 _nn_ops.set_hwio_weights(prev)
@@ -446,6 +486,10 @@ class SPMDTrainer:
                 loss = _as_masked_scalar_loss(loss_fn, out, label, pad)
             else:
                 loss = _as_scalar_loss(loss_fn, out, label)
+            if instrument:
+                _numerics.record(fstats, "out", out)
+                _numerics.record(fstats, "loss", loss)
+                return loss, (new_aux, out, ctx.records, fstats)
             return loss, (new_aux, out, ctx.records)
 
         guard = self._guard_mode
@@ -462,10 +506,14 @@ class SPMDTrainer:
                 n: jnp.zeros((cap, sparse_meta[n]["dim"]),
                              ddt or emb_tables[n].dtype)
                 for n in sparse_names}
-            (loss, (new_aux, _, recs)), (grads, dgrads) = jax.value_and_grad(
+            (loss, aux), (grads, dgrads) = jax.value_and_grad(
                 loss_of, argnums=(0, 1), has_aux=True)(
                     train_params, deltas, aux_params, emb_tables, data,
                     label, key)
+            if instrument:
+                new_aux, _, recs, stats = aux
+            else:
+                (new_aux, _, recs), stats = aux, None
             new_params = {}
             new_state = {}
             from .. import random as _random
@@ -482,6 +530,8 @@ class SPMDTrainer:
                         gv = _preprocess(
                             optimizer,
                             dgrads[n].astype(emb_tables[n].dtype))
+                        if stats is not None:
+                            _numerics.record(stats, "grad." + n, gv)
                         w, s = _pemb.update_unique(
                             optimizer, emb_tables[n], opt_state[n], uniq,
                             gv, lrs[i] * lr_scale, wds[i], t,
@@ -491,6 +541,8 @@ class SPMDTrainer:
                         new_state[n] = s
                         continue
                     g = _preprocess(optimizer, grads[n])
+                    if stats is not None:
+                        _numerics.record(stats, "grad." + n, g)
                     if fused_opt and \
                             train_params[n].dtype == jnp.float32:
                         w, _m, s = optimizer.step_fused(
@@ -505,9 +557,14 @@ class SPMDTrainer:
                                           wds[i], t)
                     new_params[n] = w.astype(train_params[n].dtype)
                     new_state[n] = s
+            if stats is not None:
+                for n in trainable:
+                    _numerics.record(stats, "update." + n, new_params[n])
             aux_out = dict(aux_params)
             aux_out.update(new_aux)
             if not guard:
+                if stats is not None:
+                    return new_params, aux_out, new_state, loss, stats
                 return new_params, aux_out, new_state, loss
             from .. import resilience as _resilience
             finite = _resilience.all_finite(loss, grads, dgrads)
@@ -518,11 +575,49 @@ class SPMDTrainer:
                                                  old_params)
             new_state = _resilience.select_tree(finite, new_state, opt_state)
             aux_out = _resilience.select_tree(finite, aux_out, aux_params)
+            if stats is not None:
+                return (new_params, aux_out, new_state, loss, new_streak,
+                        stats)
             return new_params, aux_out, new_state, loss, new_streak
 
         self._batch_sharding = batch_sh
         donate = (0, 2, 3) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
+
+    def _program(self, pad, instrument=False):
+        """Fetch-or-build the fused step program for ``(pad, variant)``.
+        The program cache is keyed by pad count — the pad-masked loss
+        uses a STATIC slice so its reduction is structurally identical
+        to the unpadded program's (bitwise-equal losses) — each distinct
+        tail size costs one compile, bounded by the bucket policy.  The
+        numerics-instrumented variant is a separate entry: both coexist,
+        so cadenced capture never evicts the plain program."""
+        from .. import numerics as _numerics
+        from .. import tracing as _tracing
+        ntok = _numerics.capture_token(instrument)
+        jitted = self._jitted.get((pad, ntok))
+        if jitted is not None:
+            return jitted
+        from .. import perf as _perf
+        # kernels=on earns its own program key; the OFF key is
+        # unchanged from earlier rounds so perf artifacts stay
+        # comparable across releases.  A program built after an
+        # autotune winner landed gets its own key too, so the tuned
+        # and untuned registrations coexist in perf exports.
+        pkey = "pad=%d/guard=%s" % (pad, self._guard_mode)
+        if self._kernel_mode:
+            pkey += "/kernels=on"
+        if getattr(self, "_autotune_gen", 0):
+            pkey += "/at%d" % self._autotune_gen
+        if instrument:
+            pkey += "/numerics"
+        with _tracing.span("spmd.compile", cat="spmd"):
+            jitted = self._jitted[(pad, ntok)] = _perf.wrap(
+                self._build(pad, instrument=instrument), "spmd", pkey,
+                source="spmd")
+        from .. import profiler as _profiler
+        _profiler.counter_increment("fused_compiles")
+        return jitted
 
     # ------------------------------------------------------------ public
     def step(self, data, label, lr_scale=1.0, pad=0):
@@ -601,32 +696,18 @@ class SPMDTrainer:
                              or agen != getattr(self, "_autotune_gen",
                                                 agen)):
             self._jitted.clear()  # knob flip: rebuild with/without the guard
-        # the program cache is keyed by pad count: the pad-masked loss uses
-        # a STATIC slice so its reduction is structurally identical to the
-        # unpadded program's (bitwise-equal losses) — each distinct tail
-        # size costs one compile, bounded by the bucket policy
-        jitted = self._jitted.get(pad)
-        if jitted is None:
-            self._guard_mode = guard
-            self._kernel_mode = kmode
-            self._config_epoch = epoch
-            self._autotune_gen = agen
-            from .. import perf as _perf
-            # kernels=on earns its own program key; the OFF key is
-            # unchanged from earlier rounds so perf artifacts stay
-            # comparable across releases.  A program built after an
-            # autotune winner landed gets its own key too, so the tuned
-            # and untuned registrations coexist in perf exports.
-            pkey = "pad=%d/guard=%s" % (pad, guard)
-            if kmode:
-                pkey += "/kernels=on"
-            if agen:
-                pkey += "/at%d" % agen
-            with _tracing.span("spmd.compile", cat="spmd"):
-                jitted = self._jitted[pad] = _perf.wrap(
-                    self._build(pad), "spmd", pkey, source="spmd")
-            from .. import profiler as _profiler
-            _profiler.counter_increment("fused_compiles")
+        self._guard_mode = guard
+        self._kernel_mode = kmode
+        self._config_epoch = epoch
+        self._autotune_gen = agen
+        # numerics cadence (mx.numerics): on a capture step the program
+        # cache serves the instrumented VARIANT — its own (pad, token)
+        # entry, so off-cadence steps replay the plain program unchanged
+        # and a capture-knob toggle never clears this cache (the knob is
+        # epoch-neutral in config.py)
+        from .. import numerics as _numerics
+        cap = _numerics.should_capture("spmd")
+        jitted = self._program(pad, instrument=cap)
         # the batch shard_put is the host->mesh boundary; the gradient
         # allreduce itself is a compiler-scheduled psum INSIDE the jitted
         # step (visible on the device plane of a merged trace, not here).
@@ -665,15 +746,54 @@ class SPMDTrainer:
         args = (train, aux, self.opt_state) + \
             ((tables,) if sparse else ()) + (data, label, key, t_arr, lrs,
                                              wds, sarr)
+        stats = None
         if self._guard_mode:
             if self._nan_streak is None:
                 self._nan_streak = jnp.zeros((), jnp.int32)
-            new_train, new_aux, self.opt_state, loss, self._nan_streak = \
-                jitted(*args, self._nan_streak)
+            res = jitted(*args, self._nan_streak)
+            if cap:
+                (new_train, new_aux, self.opt_state, loss,
+                 self._nan_streak, stats) = res
+            else:
+                new_train, new_aux, self.opt_state, loss, \
+                    self._nan_streak = res
             # no-sync host inspection of completed steps' streaks
             _resilience.watch_streak("spmd", self._nan_streak)
+
+            def _replay(data=data, label=label, key=key, t_arr=t_arr,
+                        lrs=lrs, wds=wds, sarr=sarr, pad=pad):
+                # nanguard forensics (mx.numerics): re-run THIS batch
+                # once through the instrumented variant.  Params and opt
+                # state are read live (last-good after select_tree) and
+                # COPIED because the replay donates them like any step;
+                # the abort path still checkpoints the originals after.
+                import jax as _jax
+                fi = self._program(pad, instrument=True)
+                spn = {n for n in self._sparse_embed
+                       if n in self.fn.trainable}
+                train = _jax.tree_util.tree_map(
+                    jnp.array,
+                    {n: self.params[n] for n in self.fn.trainable
+                     if n not in spn})
+                tables = _jax.tree_util.tree_map(
+                    jnp.array, {n: self.params[n] for n in spn})
+                aux = {n: self.params[n] for n in self.fn.aux}
+                ost = _jax.tree_util.tree_map(jnp.array, self.opt_state)
+                a = (train, aux, ost) + ((tables,) if spn else ()) + \
+                    (data, label, key, t_arr, lrs, wds, sarr)
+                return fi(*a, jnp.zeros((), jnp.int32))[-1]
+
+            _numerics.hold_replay("spmd", _replay)
         else:
-            new_train, new_aux, self.opt_state, loss = jitted(*args)
+            res = jitted(*args)
+            if cap:
+                new_train, new_aux, self.opt_state, loss, stats = res
+            else:
+                new_train, new_aux, self.opt_state, loss = res
+        if stats is not None:
+            # device stats enter the pending queue; drained by the
+            # is-ready poll later — zero host sync on this thread
+            _numerics.publish("spmd", self._step_num, stats)
         from .. import profiler as _profiler
         _profiler.counter_increment("fused_steps")
         if sparse:

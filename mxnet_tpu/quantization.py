@@ -291,6 +291,30 @@ def _recolor_patch(plan, thresholds, excluded):
     return make
 
 
+def _probe_recolor_patch(plan, thresholds, excluded, sink):
+    """Stats-twin wrappers: identical recolor routing to
+    :func:`_recolor_patch`, but every site that executes quantized ALSO
+    contributes its runtime activation ``|max|`` (f32, pre-quantization)
+    to ``sink`` as ``(site, scalar)`` in execution order — the drift
+    probe program serving samples against the calibration manifest."""
+    inner = _recolor_patch(plan, thresholds, excluded)
+
+    def make(op_name, orig_fn):
+        recolored = inner(op_name, orig_fn)
+
+        def probed(data, weight, *args, **attrs):
+            before = len(plan.sites_hit)
+            out = recolored(data, weight, *args, **attrs)
+            if len(plan.sites_hit) > before:
+                x = jnp.asarray(getattr(data, "_data", data))
+                sink.append((plan.sites_hit[-1],
+                             jnp.max(jnp.abs(x.astype(jnp.float32)))))
+            return out
+        return probed
+
+    return make
+
+
 # ------------------------------------------------------------ calibration
 
 class Calibration:
@@ -588,6 +612,57 @@ def export_quantized(block, prefix, calibration, excluded=(),
     with open(hlo_path, "wb") as f:
         f.write(exp.serialize())
     paths.append(hlo_path)
+
+    # drift-monitoring stats twin: same recolor routing as infer_q, but
+    # the program's output is the stack of per-quantized-site runtime
+    # activation |max| values; serving samples it every Nth quantized
+    # dispatch and compares against the calibration thresholds
+    # (docs/OBSERVABILITY.md "Numerics plane")
+    stats_sites = []
+
+    def infer_stats(params, x):
+        scales = dict(zip(scale_names, params[len(names):]))
+        param_map = {}
+        for n, v in zip(names, params[:len(names)]):
+            if n in qweights:
+                v = v.astype(jnp.float32) * scales[n + SCALE_SUFFIX]
+            param_map[n] = v
+        plan = _SitePlan()
+        sink = []
+        with _patched_ops(plan, _probe_recolor_patch(plan, thresholds,
+                                                     excluded, sink)):
+            fn.apply(param_map, (x,), key=jax.random.PRNGKey(0),
+                     training=False)
+        stats_sites[:] = [s for s, _ in sink]
+        return jnp.stack([a for _, a in sink])
+
+    stats_exp = None
+    try:
+        jstats = jax.jit(infer_stats)
+        if exported_dynamic:
+            try:
+                b = jexport.symbolic_shape("b")[0]
+                sspec = (param_spec,
+                         jax.ShapeDtypeStruct((b,) + tuple(data0.shape[1:]),
+                                              data0.dtype))
+                stats_exp = jexport.export(jstats)(*sspec)
+            except Exception:  # noqa: BLE001 — fall back to static batch
+                stats_exp = None
+        if stats_exp is None:
+            sspec = (param_spec,
+                     jax.ShapeDtypeStruct(data0.shape, data0.dtype))
+            stats_exp = jexport.export(jstats)(*sspec)
+    except Exception:  # noqa: BLE001 — nothing quantized: no twin
+        stats_exp = None
+        stats_sites = []
+    if stats_exp is not None and stats_sites:
+        stats_path = prefix + "-stats.stablehlo"
+        with open(stats_path, "wb") as f:
+            f.write(stats_exp.serialize())
+        paths.append(stats_path)
+    else:
+        stats_sites = []
+
     meta = {
         "param_names": names + scale_names,
         "input_shape": list(data0.shape),
@@ -598,6 +673,7 @@ def export_quantized(block, prefix, calibration, excluded=(),
         "format_version": _deploy.QUANTIZED_FORMAT_VERSION,
         "quantized": True,
         "quantized_params": qnames,
+        "stats_sites": list(stats_sites),
         "excluded": sorted(excluded),
         "measured_error": round(measured, 6),
         "error_budget": error_budget,

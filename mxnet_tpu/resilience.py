@@ -492,6 +492,10 @@ def guarded_streak(finite, streak, source=None):
 # returned streak scalars awaiting a no-sync host inspection
 _STREAK_PENDING = {}  # source -> list of (jax.Array) in step order
 _STREAK_PENDING_MAX = 64  # force-drain bound: ~seconds of lag, tiny memory
+# serializes the pop-from-front drain: concurrent pollers on one source
+# would otherwise double-pop (dropping an observation on the floor) or
+# IndexError on an emptied queue.  append stays lock-free.
+_STREAK_DRAIN_LOCK = threading.Lock()
 
 
 def watch_streak(source, streak):
@@ -514,15 +518,18 @@ def poll_streaks(source=None, block=False):
     for src in sources:
         q = _STREAK_PENDING.get(src)
         while q:
-            arr = q[0]
-            try:
-                if not block and not arr.is_ready():
+            with _STREAK_DRAIN_LOCK:
+                if not q:
                     break
-                v = int(arr)
-            except Exception:  # noqa: BLE001 — a dead buffer ends the watch
+                arr = q[0]
+                try:
+                    if not block and not arr.is_ready():
+                        break
+                    v = int(arr)
+                except Exception:  # noqa: BLE001 — dead buffer ends watch
+                    q.pop(0)
+                    continue
                 q.pop(0)
-                continue
-            q.pop(0)
             if v > 0:
                 report_nonfinite(src, streak=v)
             else:
@@ -598,6 +605,15 @@ def maybe_abort_nonfinite(source, save_fn=None):
     if source not in _NAN_ABORT:
         return
     streak = _NAN_ABORT.pop(source)
+    try:
+        # root-cause pass BEFORE the flight-recorder dump so the
+        # nanguard_forensics ring event (first non-finite site) lands in
+        # the report; replays the held failing batch through the
+        # stats-instrumented program (docs/OBSERVABILITY.md)
+        from . import numerics as _numerics
+        _numerics.run_forensics(source)
+    except Exception as exc:  # noqa: BLE001 — forensics must not mask abort
+        _log("nanguard forensics failed: %s: %s", type(exc).__name__, exc)
     report = None
     try:
         from . import tracing

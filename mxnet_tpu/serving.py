@@ -310,13 +310,22 @@ class _ModelEntry:
 
     __slots__ = ("name", "prefix", "predictor", "buckets", "programs",
                  "item_shape", "in_dtype", "breaker", "shed",
-                 "deadline_exceeded", "quantized", "cost_per_item")
+                 "deadline_exceeded", "quantized", "cost_per_item",
+                 "drift_call", "drift_sites", "drift_count", "drift_ewma")
 
     def __init__(self, name, prefix, predictor, buckets):
         self.name = name
         self.prefix = prefix
         self.predictor = predictor
         self.quantized = bool(getattr(predictor, "quantized", False))
+        # quantization drift probe (docs/OBSERVABILITY.md): the stats
+        # twin exported next to the int8 program, lazily loaded on the
+        # first sampled dispatch; False = tried and absent
+        self.drift_call = None
+        meta = getattr(predictor, "meta", None) or {}
+        self.drift_sites = tuple(meta.get("stats_sites") or ())
+        self.drift_count = 0
+        self.drift_ewma = {}
         self.buckets = tuple(buckets)
         self.programs = {}
         shape = predictor.meta.get("input_shape") or []
@@ -632,6 +641,47 @@ class Server:
                     "serving.bytes_per_request.%s" % entry.name).set(
                     round(entry.cost_per_item["bytes"], 1))
         return program
+
+    # ------------------------------------------------- quantization drift
+    def _load_drift_twin(self, entry):
+        """Deserialize ``<prefix>-stats.stablehlo`` (the per-site runtime
+        amax program exported next to the int8 artifact) into a jitted
+        call over the entry's staged params; ``False`` when the artifact
+        ships no twin (pre-PR-18 exports, nothing quantized)."""
+        import os
+        from jax import export as jexport
+        path = entry.prefix + "-stats.stablehlo"
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            stats_exp = jexport.deserialize(f.read())
+        return jax.jit(lambda ps, x: stats_exp.call(ps, x))
+
+    def _maybe_sample_drift(self, entry, padded):
+        """Every ``quant.drift_every``-th quantized dispatch, re-run the
+        dispatched batch through the artifact's stats twin and fold the
+        per-site runtime activation amax into the drift EWMA
+        (``quant.drift_ratio.<model>.<site>`` gauges, ``quant_drift``
+        JSONL events past ``quant.drift_threshold``).  The probe is an
+        extra device program per sampled dispatch — off (0) by
+        default."""
+        every = int(_config.get("quant.drift_every") or 0)
+        if every <= 0 or not entry.drift_sites:
+            return
+        entry.drift_count += 1
+        if entry.drift_count % every:
+            return
+        if entry.drift_call is None:
+            entry.drift_call = self._load_drift_twin(entry)
+        if entry.drift_call is False:
+            return
+        from . import numerics as _numerics
+        amaxes = _np.asarray(
+            entry.drift_call(entry.predictor._params, padded))
+        cal = (entry.predictor.meta.get("calibration") or {})
+        thresholds = cal.get("thresholds") or {}
+        _numerics.update_quant_drift(entry.name, entry.drift_sites,
+                                     amaxes, thresholds, entry.drift_ewma)
 
     # --------------------------------------------------------- lifecycle
     def start(self):
@@ -1211,6 +1261,12 @@ class Server:
         _telemetry.counter("serving.batch_dispatches").inc()
         if entry.quantized:
             _telemetry.counter("serving.quantized_dispatches").inc()
+            try:
+                self._maybe_sample_drift(entry, padded)
+            except Exception as exc:  # noqa: BLE001 — the probe is
+                # observability; it must never fail a served batch
+                _LOG.warning("serving: drift probe failed for %r: %s: %s",
+                             entry.name, type(exc).__name__, exc)
         _telemetry.timer("serving.batch_fill").observe(rows / bucket)
         _telemetry.timer("serving.dispatch_ms").observe((t1 - t0) * 1e3)
         with self._cond:

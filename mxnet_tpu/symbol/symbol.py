@@ -815,6 +815,8 @@ def _eval_symbol(sym, env, training, aux_updates=None):
     """Interpret the DAG on jax values.  ``env`` maps var name -> array.
     Returns the list of head outputs.  Runs under jit when called from a
     bound Executor — pure apart from the explicit aux_updates dict."""
+    from .. import numerics as _numerics
+    taps = _numerics.collecting()
     cache = {}
 
     def value(node, index=0):
@@ -845,6 +847,14 @@ def _eval_symbol(sym, env, training, aux_updates=None):
             outs = list(res) if multi else [res]
             for i, o in enumerate(outs):
                 cache[(id(node), i)] = o
+            if taps:
+                # per-op-output numerics tap sites (trace-time = the
+                # graph's topological order); only instrumented program
+                # variants ever evaluate with a collector open
+                for i, o in enumerate(outs):
+                    _numerics.tap(
+                        node.name if not multi
+                        else "%s[%d]" % (node.name, i), o)
             if training and aux_updates is not None \
                     and node.op in _AUX_UPDATE_RULES:
                 aux_updates.update(
@@ -1041,7 +1051,7 @@ class Executor:
                                                static_argnames=())
         return self._bwd_cache[key_sig]
 
-    def fused_step_fn(self, wrt, optimizer, feed_sig):
+    def fused_step_fn(self, wrt, optimizer, feed_sig, instrument=False):
         """ONE jitted program carrying forward + backward + optimizer
         update — the CachedOp ``static_alloc=True`` analog for the symbolic
         path (reference: src/imperative/cached_op.cc StaticForward/
@@ -1063,8 +1073,16 @@ class Executor:
         ``_opt_hyper_arrays`` pattern from mxnet_tpu/parallel/trainer.py),
         so lr schedulers keep working instead of constant-folding; ``t`` is
         the traced update count for bias-corrected optimizers (Adam &c).
+
+        ``instrument=True`` builds the numerics-instrumented VARIANT of
+        the program (mx.numerics): per-op tap sites inside the forward
+        plus grad./update. stats per param ride out as one extra stats
+        dict appended to the return tuple.  The variant is a separate
+        cache entry — the plain program stays byte-identical to a build
+        without taps and toggling the capture knob never evicts it.
         """
         from .. import config as _config
+        from .. import numerics as _numerics
         from .. import resilience as _resilience
         sym = self._symbol
         wrt_t = tuple(wrt)
@@ -1078,8 +1096,9 @@ class Executor:
         # scalars baked in at trace time) is part of the key; cached entries
         # keep their optimizer alive, so id() stays unambiguous
         from .. import autotune as _autotune
-        key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig, guard,
-                   (_config.epoch(), _autotune.generation()))
+        key_sig = (id(optimizer), rescale, clip, wrt_t, feed_sig, guard) \
+            + _numerics.capture_token(instrument) \
+            + ((_config.epoch(), _autotune.generation()),)
         fn = self._fused_cache.get(key_sig)
         if fn is not None:
             return fn
@@ -1105,10 +1124,20 @@ class Executor:
                 e.update(wv)
                 aux_updates = {}
                 with _random.trace_key_scope(key):
+                    if instrument:
+                        # tap values traced under vjp are vjp-internal —
+                        # they escape through vjp's aux, never the outer
+                        # return (a direct return would leak tracers)
+                        with _numerics.collect() as fstats:
+                            outs = _eval_symbol(sym, e, True, aux_updates)
+                        return outs, (aux_updates, dict(fstats))
                     outs = _eval_symbol(sym, e, True, aux_updates)
                 return outs, aux_updates
 
             outs, vjp, aux_updates = jax.vjp(fwd, wrt_vals, has_aux=True)
+            stats = None
+            if instrument:
+                aux_updates, stats = aux_updates
             # out_grads=None semantics: ones cotangents, as in backward()
             (grads,) = vjp([jnp.ones_like(o) for o in outs])
             new_w = {}
@@ -1119,6 +1148,8 @@ class Executor:
                     g = grads[n] * rescale
                     if clip is not None:
                         g = jnp.clip(g, -clip, clip)
+                    if stats is not None:
+                        _numerics.record(stats, "grad." + n, g)
                     if fused_opt and wrt_vals[n].dtype == jnp.float32:
                         w, _m, s = optimizer.step_fused(
                             wrt_vals[n], g, opt_state[n], lrs[i], wds[i],
@@ -1130,7 +1161,14 @@ class Executor:
                                           lrs[i], wds[i], t)
                     new_w[n] = w.astype(wrt_vals[n].dtype)
                     new_s[n] = s
+            if stats is not None:
+                # pre-guard candidate updates: on a bad step these SHOW
+                # the non-finite values forensics is after
+                for n in wrt_t:
+                    _numerics.record(stats, "update." + n, new_w[n])
             if not guard:
+                if stats is not None:
+                    return new_w, new_s, aux_updates, outs, stats
                 return new_w, new_s, aux_updates, outs
             # non-finite step guard: keep old params/state/aux on a bad
             # step; the check stays on-device (no host sync unless the
@@ -1143,6 +1181,8 @@ class Executor:
             aux_updates = _resilience.select_tree(
                 finite, aux_updates,
                 {n: rest_env[n] for n in aux_updates})
+            if stats is not None:
+                return new_w, new_s, aux_updates, outs, new_streak, stats
             return new_w, new_s, aux_updates, outs, new_streak
 
         # donation needs a real accelerator: the CPU backend can't alias

@@ -1,0 +1,187 @@
+"""mx.monitor on the FUSED module step (PR 18 satellites).
+
+The reference Monitor forced Module onto the eager stage-at-a-time path
+(the fused program materializes no per-op intermediates); now a Monitor
+keeps the step FUSED — outputs fire through the callback after the
+dispatch, ``toc()`` reads the written-back arg_dict — with a one-time
+warning pointing at ``numerics.capture`` for per-site stats.  Raw
+callbacks still force eager.  Also covers ``Monitor.uninstall`` (the
+reference ``install`` appended executors forever) and ``fit(monitor=)``
+actually installing (it was silently dead before)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+
+
+def _mlp_softmax():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(h, label, name="softmax")
+
+
+def _toy_data(n=64, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = np.argmax(X[:, :k], axis=1).astype(np.float32)
+    return X, Y
+
+
+def _fixed_init_params(seed=7):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(16, 10).astype(np.float32)
+                                      * 0.1),
+            "fc1_bias": mx.nd.array(np.zeros(16, np.float32)),
+            "fc2_weight": mx.nd.array(rng.randn(3, 16).astype(np.float32)
+                                      * 0.1),
+            "fc2_bias": mx.nd.array(np.zeros(3, np.float32))}
+
+
+def _bound_module(mode, fixed_params=False):
+    config.set("module.fused_step", mode)
+    mod = mx.mod.Module(_mlp_softmax())
+    mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    if fixed_params:
+        mod.init_params(initializer=None, arg_params=_fixed_init_params())
+    else:
+        mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _restore_fused_knob():
+    prev = config.get("module.fused_step")
+    yield
+    config.set("module.fused_step", prev)
+
+
+@pytest.mark.parametrize("mode", ["on", "off"])
+def test_monitor_collects_on_fused_and_eager(mode):
+    """tic()/toc_print() report interval stats on BOTH step paths; the
+    fused path stays fused (fused_steps advances with the monitor
+    installed)."""
+    from mxnet_tpu import profiler
+    X, Y = _toy_data()
+    mod = _bound_module(mode)
+    mon = mx.monitor.Monitor(interval=1, pattern=".*")
+    mon.install(mod._exec)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    fused0 = profiler.counters().get("fused_steps", 0)
+    rows = []
+    for i, batch in enumerate(it):
+        if i == 2:
+            break
+        mon.tic()
+        mod.train_step(batch)
+        rows.extend(mon.toc())
+    assert rows, "monitor collected nothing"
+    names = {k for _, k, _ in rows}
+    # arg_dict params always land; the fused path also fires outputs
+    assert "fc1_weight" in names and "fc2_bias" in names
+    fused_ran = profiler.counters().get("fused_steps", 0) - fused0
+    if mode == "on":
+        assert fused_ran == 2, "Monitor forced the step off the fused path"
+        assert "softmax_output" in names
+    else:
+        assert fused_ran == 0
+
+
+def test_monitor_fused_warns_once(caplog):
+    import logging
+    X, Y = _toy_data()
+    mod = _bound_module("on")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(mod._exec)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    with caplog.at_level(logging.WARNING):
+        for i, batch in enumerate(it):
+            if i == 3:
+                break
+            mod.train_step(batch)
+    hits = [r for r in caplog.records
+            if "Monitor installed on a FUSED" in r.getMessage()]
+    assert len(hits) == 1
+
+
+def test_raw_callback_still_forces_eager():
+    from mxnet_tpu import profiler
+    X, Y = _toy_data()
+    mod = _bound_module("on")
+    seen = []
+    mod._exec.set_monitor_callback(lambda name, arr: seen.append(name))
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    fused0 = profiler.counters().get("fused_steps", 0)
+    mod.train_step(next(it))
+    assert profiler.counters().get("fused_steps", 0) == fused0
+    assert seen, "raw callback never fired on the eager path"
+
+
+def test_fused_vs_eager_monitor_stat_parity():
+    """Same params, same batch: the interval param stats a Monitor
+    reports on the fused path match the eager path's."""
+    def run(mode):
+        X, Y = _toy_data()
+        mod = _bound_module(mode, fixed_params=True)
+        mon = mx.monitor.Monitor(interval=1, pattern=".*weight")
+        mon.install(mod._exec)
+        it = mx.io.NDArrayIter(X, Y, batch_size=16)
+        mon.tic()
+        mod.train_step(next(it))
+        return {k: float(v) for _, k, v in mon.toc()}
+
+    eager = run("off")
+    fused = run("on")
+    for name in ("fc1_weight", "fc2_weight"):
+        assert name in eager and name in fused
+        assert eager[name] == pytest.approx(fused[name], rel=1e-5)
+
+
+def test_monitor_install_dedups_and_uninstall():
+    mod = _bound_module("on")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(mod._exec)
+    mon.install(mod._exec)   # reinstall: no leak
+    assert len(mon.exes) == 1
+    mon.uninstall(mod._exec)
+    assert mon.exes == []
+    assert mod._exec._monitor is None
+    mon.uninstall(mod._exec)  # unknown exe: ignored
+
+
+def test_monitor_uninstall_leaves_foreign_callback():
+    mod = _bound_module("on")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(mod._exec)
+    other = lambda name, arr: None  # noqa: E731
+    mod._exec.set_monitor_callback(other)
+    mon.uninstall(mod._exec)   # not ours anymore: callback kept
+    assert mod._exec._monitor is other
+
+
+def test_monitor_uninstall_all():
+    mod = _bound_module("on")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(mod._exec)
+    mon.uninstall_all()
+    assert mon.exes == [] and mod._exec._monitor is None
+
+
+def test_fit_installs_monitor():
+    """fit(monitor=...) wires the monitor in (the param was dead before
+    PR 18) and per-batch tic/toc_print runs it."""
+    X, Y = _toy_data()
+    config.set("module.fused_step", "on")
+    mod = mx.mod.Module(_mlp_softmax())
+    mon = mx.monitor.Monitor(interval=1, pattern=".*weight")
+    train = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.1), monitor=mon)
+    assert any(e is mod._exec for e in mon.exes)
+    assert mon.step > 0, "fit never ran tic()"

@@ -489,3 +489,56 @@ def test_check_resilience_smoke():
     assert report["resume"]["loss_curve_bitwise"], report
     assert report["resume"]["params_bitwise"], report
     assert report["chaos"]["io_injected"] > 0, report
+
+
+def test_poll_streaks_concurrent_watch_streak_thread_stress():
+    """Regression: ``poll_streaks`` used an unlocked pop-from-front
+    drain, so concurrent ``watch_streak`` producers (each call also
+    polls) could double-pop — silently dropping a bad-step observation
+    — or IndexError on an emptied queue.  Hammer one source from many
+    threads and assert every enqueued observation is accounted for."""
+    import threading
+
+    n_threads, per_thread = 4, 50
+    # streak values per producer: every 5th observation is a bad step
+    # (positive streak); arrays are ready so pollers race on the drain,
+    # not on device sync.
+    vals = [[1 if i % 5 == 0 else 0 for i in range(per_thread)]
+            for _ in range(n_threads)]
+    arrays = [[jnp.asarray(v, dtype=jnp.int32) for v in row]
+              for row in vals]
+    jax.block_until_ready(arrays)
+    expected_bad = sum(v > 0 for row in vals for v in row)
+
+    errors = []
+    barrier = threading.Barrier(n_threads + 1)
+
+    def produce(row):
+        barrier.wait()
+        try:
+            for arr in row:
+                resilience.watch_streak("stress", arr)
+        except Exception as exc:  # noqa: BLE001 — assert below
+            errors.append(exc)
+
+    def drain_hard():
+        barrier.wait()
+        try:
+            for _ in range(200):
+                resilience.poll_streaks()
+        except Exception as exc:  # noqa: BLE001 — assert below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=produce, args=(row,))
+               for row in arrays]
+    threads.append(threading.Thread(target=drain_hard))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    resilience.poll_streaks("stress", block=True)
+
+    assert not errors, errors
+    assert not resilience._STREAK_PENDING.get("stress")
+    stats = resilience.nonfinite_stats("stress")
+    assert stats["total"] == expected_bad, stats

@@ -56,6 +56,12 @@ percentiles — plus:
     page-worthy signal (the live multi-window version runs in
     mx.obs.SLOTracker; this is the offline mirror).
 
+``quant_drift`` records (one per newly-drifted quantized site, written
+by the mx.numerics serving drift probe — ``quant.drift_every`` > 0) fold
+into a per-(model, site) anomaly carrying the worst observed EWMA ratio:
+the runtime activation range has left the int8 calibration envelope and
+the artifact should be recalibrated.
+
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # tables + flags
   python tools/telemetry_report.py RUN.jsonl --json   # machine-readable
@@ -320,9 +326,11 @@ def summarize(records, slo_availability=SLO_AVAILABILITY):
     gen_recs = [r for r in records
                 if r.get("event") == "serving_generate"]
     access_recs = [r for r in records if r.get("event") == "access"]
+    drift_recs = [r for r in records if r.get("event") == "quant_drift"]
     monitor_events = sum(1 for r in records if r.get("event") == "monitor")
     other = len(records) - len(steps) - len(serving_recs) \
-        - len(gen_recs) - len(access_recs) - monitor_events
+        - len(gen_recs) - len(access_recs) - len(drift_recs) \
+        - monitor_events
 
     sources = {}
     anomalies = []
@@ -433,6 +441,24 @@ def summarize(records, slo_availability=SLO_AVAILABILITY):
                     "detail": "steady-state MFU %.4f vs early-window %.4f "
                               "(< %d%%): same program, slower steps"
                               % (late, early, MFU_COLLAPSE * 100)})
+
+    # quantization drift: every record is an already-tripped site (the
+    # EWMA crossed quant.drift_threshold); one anomaly per (model, site)
+    # carrying the worst observed ratio
+    worst_drift = {}
+    for r in drift_recs:
+        key = (str(r.get("model", "?")), str(r.get("site", "?")))
+        prev = worst_drift.get(key)
+        if prev is None or (r.get("ratio") or 0) > (prev.get("ratio") or 0):
+            worst_drift[key] = r
+    for (model, site), r in sorted(worst_drift.items()):
+        anomalies.append({
+            "kind": "quant_drift", "source": model,
+            "detail": "quantized site %s runtime-amax EWMA reached %.3fx "
+                      "its calibrated threshold (drift threshold %.2fx) — "
+                      "recalibrate the int8 artifact"
+                      % (site, float(r.get("ratio") or 0.0),
+                         float(r.get("threshold") or 0.0))})
 
     serving = _summarize_serving(serving_recs, anomalies)
     generation = _summarize_generation(gen_recs, anomalies)
