@@ -50,6 +50,7 @@ _LAZY = {
     "tracing": ".tracing",
     "obs": ".obs",
     "resilience": ".resilience",
+    "elastic": ".elastic",
     "perf": ".perf",
     "kernels": ".kernels",
     "runtime": ".runtime",

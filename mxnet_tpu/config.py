@@ -34,7 +34,11 @@ _ON_SET = {}  # knob name -> callback(value), fired after set()
 # knob states coexist in the caches and a toggle must not evict compiled
 # programs.  Side-effect hooks still fire.
 _EPOCH_NEUTRAL = {"numerics.capture", "quant.drift_every",
-                  "quant.drift_threshold"}
+                  "quant.drift_threshold",
+                  # elastic state is pure host-side bookkeeping: restart
+                  # generation / heartbeat cadence must not evict programs
+                  "elastic.dir", "elastic.generation",
+                  "elastic.heartbeat_s", "elastic.on_peer_loss"}
 
 
 def register_knob(name, env, type_, default, doc):
@@ -437,8 +441,12 @@ register_knob(
     "fetch), kvstore (push/pull), ckpt_write (inside atomic_write), nan "
     "(poison a training batch), serving_dispatch (fail an mx.serving "
     "batch dispatch — feeds the circuit breaker), serving_slow (delay a "
-    "serving dispatch ~250ms — stall/deadline/shed testing). Empty "
-    "(default) disables the harness.")
+    "serving dispatch ~250ms — stall/deadline/shed testing), "
+    "peer_preempt (simulate a peer preemption inside mx.elastic's "
+    "cluster agreement — every rank checkpoints and exits together), "
+    "dcn_push (fail a kvstore DCN allreduce hop — exercises "
+    "retry/backoff on the slow axis). Empty (default) disables the "
+    "harness.")
 register_knob(
     "resilience.fault_seed", "MXNET_TPU_FAULT_SEED", int, 0,
     "seed for the fault-injection RNGs and retry jitter; two runs with "
@@ -500,6 +508,65 @@ register_knob(
     "MXTPU_GRAD_COMPRESSION_THRESHOLD", float, 0.5,
     "threshold for 2-bit gradient compression (kvstore."
     "set_gradient_compression), reference gradient_compression.cc:44.")
+register_knob(
+    "kvstore.grad_compress", "MXNET_TPU_GRAD_COMPRESS", str, "",
+    "gradient-sync wire compression: '2bit' folds two_bit_compress -> "
+    "allreduce codes -> decompress + error-feedback residual into (a) the "
+    "kvstore dist_sync DCN hop (packed 4 codes/byte, 16x fewer wire bytes "
+    "than f32) and (b) the fused SPMD train step on meshes that declare a "
+    "'dcn' axis (ICI psum stays full-precision). Residuals ride as "
+    "donated opt-state so compression composes with nanguard rollback. "
+    "Telemetry: kvstore.compressed_bytes / kvstore.compression_ratio. "
+    "Empty (default) disables.")
+
+
+def _apply_kvstore_grad_compress(value):
+    v = (value or "").strip()
+    if v not in ("", "2bit"):
+        # reject at set() time and revert (the nanguard pattern): a typo'd
+        # codec must not silently train uncompressed while claiming otherwise
+        _OVERRIDES.pop("kvstore.grad_compress", None)
+        raise ValueError("kvstore.grad_compress must be '' or '2bit', "
+                         "got %r" % (value,))
+
+
+_ON_SET["kvstore.grad_compress"] = _apply_kvstore_grad_compress
+
+# multi-host elasticity (docs/RESILIENCE.md "Multi-host elasticity")
+register_knob(
+    "elastic.dir", "MXTPU_ELASTIC_DIR", str, "",
+    "state directory for elastic multi-host runs (set by tools/launch.py "
+    "--elastic): heartbeat lease files, preemption flags and the "
+    "coordinated checkpoint protocol live here. Non-empty activates "
+    "mx.elastic's per-step cluster preemption agreement.")
+register_knob(
+    "elastic.generation", "MXTPU_ELASTIC_GENERATION", int, 0,
+    "restart generation of an elastic run (0 = first launch); exported "
+    "by tools/launch.py --elastic so workers and fault rules can "
+    "distinguish a fresh world from a re-formed one.")
+register_knob(
+    "elastic.heartbeat_s", "MXTPU_ELASTIC_HEARTBEAT_S", float, 1.0,
+    "heartbeat interval for the elastic lease loop; a peer whose lease "
+    "file goes stale for 5x this interval is declared lost "
+    "(elastic.peer_lease_expired).")
+register_knob(
+    "elastic.on_peer_loss", "MXTPU_ELASTIC_ON_PEER_LOSS", str, "abort",
+    "reaction when a peer's heartbeat lease expires: 'abort' (default) "
+    "flushes sinks and exits with code 75 so the elastic launcher can "
+    "re-form the world (rescues ranks blocked in a collective on a dead "
+    "peer); 'flag' only records it (HeartbeatMonitor.peer_lost) for "
+    "harness/test inspection.")
+
+
+def _apply_elastic_on_peer_loss(value):
+    v = (value or "").strip()
+    if v not in ("abort", "flag"):
+        _OVERRIDES.pop("elastic.on_peer_loss", None)
+        raise ValueError("elastic.on_peer_loss must be 'abort' or 'flag', "
+                         "got %r" % (value,))
+
+
+_ON_SET["elastic.on_peer_loss"] = _apply_elastic_on_peer_loss
 
 # data loading / device-resident input pipeline (docs/PERF_NOTES.md)
 register_knob(

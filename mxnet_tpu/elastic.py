@@ -1,0 +1,421 @@
+"""``mx.elastic`` — preemption-tolerant multi-host training.
+
+The reference's ps-lite tier survives worker churn because the scheduler
+re-admits workers and the servers hold the state (SURVEY §2; ps-lite
+van.cc heartbeats).  The TPU-native analog has no servers to hide behind:
+every process is a worker holding a shard of the world, so elasticity is
+a *protocol* over the jax.distributed rendezvous —
+
+* **Heartbeat/lease loop** — each rank renews a lease file under the
+  elastic state dir (``MXTPU_ELASTIC_DIR``, exported by ``tools/launch.py
+  --elastic``); a peer whose lease goes stale for 5x the heartbeat
+  interval is declared lost.  Default reaction is to exit with
+  ``ABORT_EXIT_CODE`` so the launcher re-forms the world — that rescues
+  ranks blocked inside a collective on a dead peer, which no amount of
+  in-process handling can.
+* **Cluster preemption agreement** — a SIGTERM on ANY rank (or an
+  injected ``peer_preempt`` fault) must make EVERY rank finish the
+  in-flight step, write one coordinated checkpoint, and exit 0 at the
+  same step, or the next generation resumes from a torn world.  The
+  agreement is one tiny host allreduce per step: each rank contributes
+  its local preempt flag; a non-zero sum preempts everyone.
+* **Coordinated checkpoint-restore** — rank 0 writes, every rank holds a
+  barrier across the write, the manifest stamps the world shape
+  (process_count + mesh), and ``restore`` refuses snapshots without that
+  stamp: a file from a torn/uncoordinated write can never seed a resumed
+  run.
+
+Inactive (no ``elastic.dir``) everything here is a cheap no-op, so
+single-host training pays nothing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from . import config as _config
+from . import resilience as _resilience
+from . import telemetry as _telemetry
+
+__all__ = ["ABORT_EXIT_CODE", "active", "state_dir", "generation",
+           "announce_preempt", "preempt_announced", "clear_flags",
+           "cluster_preempt_requested", "maybe_cluster_preempt",
+           "HeartbeatMonitor", "ensure_heartbeat", "stop_heartbeat",
+           "CoordinatedCheckpointManager", "coordinate"]
+
+# exit code the launcher treats as "world broke, re-form and retry" —
+# distinct from 0 (clean/preempted-with-checkpoint) and generic failures
+ABORT_EXIT_CODE = 75
+
+
+def _log(msg, *args):
+    sys.stderr.write("[mxnet_tpu.elastic] " + (msg % args) + "\n")
+
+
+def active():
+    """True when this process is part of an elastic run (elastic.dir set)."""
+    return bool(_config.get("elastic.dir"))
+
+
+def state_dir():
+    """The elastic state directory (created on first use)."""
+    d = _config.get("elastic.dir")
+    if not d:
+        raise ValueError("elastic.dir is not set (launch with "
+                         "tools/launch.py --elastic or export "
+                         "MXTPU_ELASTIC_DIR)")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def generation():
+    """Restart generation of this elastic run (0 = first launch)."""
+    return int(_config.get("elastic.generation"))
+
+
+def _rank_world():
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+# ======================================================= preemption flags
+def _flag_path(rank):
+    return os.path.join(state_dir(), "preempt-r%d" % int(rank))
+
+
+def announce_preempt(step=None):
+    """Drop this rank's preemption flag file — the launcher reads these to
+    distinguish 'preempted, restart me' (exit 0 + flag) from a genuinely
+    finished run (exit 0, no flag).  Idempotent."""
+    rank, _ = _rank_world()
+    path = _flag_path(rank)
+    if os.path.exists(path):
+        return path
+    payload = {"rank": rank, "generation": generation(),
+               "ts": round(time.time(), 3)}
+    if step is not None:
+        payload["step"] = int(step)
+    with _resilience.atomic_write(path, "w") as f:
+        json.dump(payload, f)
+    _telemetry.counter("elastic.preempt_announced").inc()
+    return path
+
+
+def preempt_announced():
+    """True when any rank has dropped a preemption flag this generation."""
+    d = _config.get("elastic.dir")
+    if not d or not os.path.isdir(d):
+        return False
+    return any(name.startswith("preempt-r") for name in os.listdir(d))
+
+
+def clear_flags(directory=None):
+    """Remove preemption flags (launcher calls this between generations)."""
+    d = directory or _config.get("elastic.dir")
+    if not d or not os.path.isdir(d):
+        return
+    for name in os.listdir(d):
+        if name.startswith("preempt-r"):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+
+
+# ================================================== cluster preempt agree
+def cluster_preempt_requested(step=None):
+    """One round of the per-step preemption agreement.
+
+    Each rank contributes its local flag — a delivered SIGTERM/SIGINT
+    (``resilience.preempt_requested``) or a ``peer_preempt`` fault drawn
+    at this step — and the host allreduce makes the decision unanimous:
+    any non-zero total preempts every rank at the SAME step boundary, so
+    the coordinated checkpoint sees one consistent world.  On agreement
+    the local preempt request is set on all ranks (so the normal
+    ``resilience.exit_on_preempt`` path finishes the job uniformly).
+    """
+    local = _resilience.preempt_requested()
+    if not local and _resilience.faults_active("peer_preempt"):
+        if _resilience.should_inject("peer_preempt", step=step):
+            _log("injected peer_preempt at step %s", step)
+            _resilience.request_preempt()
+            local = True
+    _, world = _rank_world()
+    if world > 1:
+        import numpy as np
+        from . import parallel
+        total = int(parallel.host_allreduce(np.int32(bool(local))))
+    else:
+        total = int(bool(local))
+    if total and not local:
+        # a PEER was preempted: adopt the request so this rank checkpoints
+        # and exits through the same save_and_exit path
+        _resilience.request_preempt()
+    return bool(total)
+
+
+def maybe_cluster_preempt(step=None):
+    """Per-step elastic hook for training loops: no-op unless elastic is
+    active; otherwise keep the heartbeat fresh and run the agreement,
+    dropping this rank's restart flag when the cluster decided to
+    preempt.  Returns True when the caller should checkpoint-and-exit
+    (via ``resilience.exit_on_preempt``)."""
+    if not active():
+        return False
+    ensure_heartbeat()
+    if cluster_preempt_requested(step=step):
+        announce_preempt(step=step)
+        return True
+    return False
+
+
+# ======================================================== heartbeat/lease
+class HeartbeatMonitor:
+    """Rank-local lease writer + peer lease watcher.
+
+    Every ``interval_s`` the background thread renews ``hb-r<rank>`` in
+    the elastic dir and checks the peers' files; a peer it has SEEN whose
+    lease is older than ``lease_factor`` intervals is declared lost
+    (``elastic.peer_lease_expired``).  Reaction comes from the
+    ``elastic.on_peer_loss`` knob: 'abort' exits with ABORT_EXIT_CODE so
+    the launcher re-forms the world; 'flag' records it for
+    ``peer_lost()`` (tests/harnesses).
+    """
+
+    def __init__(self, directory, rank, world, interval_s=None,
+                 lease_factor=5):
+        self.directory = os.fspath(directory)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.interval_s = float(
+            _config.get("elastic.heartbeat_s")
+            if interval_s is None else interval_s)
+        self.lease_s = self.interval_s * float(lease_factor)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None          # guarded-by[writes]: _lock
+        self._seen = set()           # guarded-by: _lock — peers with a beat
+        self._peer_lost = {}         # guarded-by: _lock — rank -> age_s
+
+    def _path(self, rank):
+        return os.path.join(self.directory, "hb-r%d" % int(rank))
+
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._beat()
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="mxtpu-elastic-heartbeat",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval_s * 2 + 1.0)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._beat()
+                self._scan()
+            except OSError as exc:  # pragma: no cover — fs hiccup
+                _log("heartbeat I/O error: %s", exc)
+
+    def _beat(self):
+        # the lease is the file's mtime: an atomic replace both publishes
+        # and renews, so a crashed writer can never leave a half lease
+        path = self._path(self.rank)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("%d %.3f\n" % (self.rank, time.time()))
+        os.replace(tmp, path)
+
+    def _scan(self):
+        now = time.time()
+        for peer in range(self.world):
+            if peer == self.rank:
+                continue
+            with self._lock:
+                if peer in self._peer_lost:
+                    continue
+            try:
+                age = now - os.stat(self._path(peer)).st_mtime
+            except OSError:
+                # never seen: a peer that has not reached its first beat
+                # yet (startup skew) is not late
+                continue
+            with self._lock:
+                self._seen.add(peer)
+            if age > self.lease_s:
+                self._expire(peer, age)
+
+    def _expire(self, peer, age):
+        with self._lock:
+            self._peer_lost[peer] = float(age)
+        _telemetry.counter("elastic.peer_lease_expired").inc()
+        _log("peer rank %d lease expired (%.1fs > %.1fs)",
+             peer, age, self.lease_s)
+        if _config.get("elastic.on_peer_loss") == "abort":
+            # a rank blocked in a collective on the dead peer cannot act
+            # on any in-process flag — exiting is the only rescue; the
+            # elastic launcher sees ABORT_EXIT_CODE and re-forms the world
+            _resilience.flush_sinks()
+            os._exit(ABORT_EXIT_CODE)
+
+    def peer_lost(self):
+        """{rank: lease_age_s} of peers declared lost (flag mode)."""
+        with self._lock:
+            return dict(self._peer_lost)
+
+
+_HB_LOCK = threading.Lock()
+_HB = None  # guarded-by[writes]: _HB_LOCK — process-wide HeartbeatMonitor
+
+
+def ensure_heartbeat():
+    """Start the process-wide heartbeat monitor (idempotent; no-op when
+    elastic is inactive or the world has a single process)."""
+    global _HB
+    if not active():
+        return None
+    if _HB is not None:
+        return _HB
+    rank, world = _rank_world()
+    if world == 1:
+        return None
+    with _HB_LOCK:
+        if _HB is None:
+            _HB = HeartbeatMonitor(state_dir(), rank, world).start()
+    return _HB
+
+
+def stop_heartbeat():
+    """Stop and forget the process-wide monitor (tests/teardown)."""
+    global _HB
+    with _HB_LOCK:
+        hb, _HB = _HB, None
+    if hb is not None:
+        hb.stop()
+
+
+# ============================================ coordinated checkpointing
+class CoordinatedCheckpointManager(_resilience.CheckpointManager):
+    """Multi-host CheckpointManager: rank-0-writes / all-ranks-barrier.
+
+    ``save`` publishes one snapshot per step: rank 0 runs the saver and
+    stamps the manifest with the world shape; every rank then holds a
+    barrier, so no rank can advance (or exit on preemption) before the
+    snapshot is fully durable.  ``restore`` REQUIRES a manifest carrying
+    the world stamp — an unstamped file is, by protocol, a torn or
+    uncoordinated write and is skipped (resilience.ckpt_fallbacks) — and
+    finishes with a cross-rank agreement that every rank resumed the
+    same step.
+
+    ``write_mode='all'`` makes every rank write (only useful when each
+    rank has a private directory, e.g. rank-local disks); the default
+    'rank0' is correct for the replicated-params single-file format on a
+    shared filesystem.
+    """
+
+    def __init__(self, directory, every_n_steps=None, keep=None,
+                 prefix="ckpt", mesh=None, write_mode="rank0"):
+        super().__init__(directory, every_n_steps=every_n_steps,
+                         keep=keep, prefix=prefix)
+        if write_mode not in ("rank0", "all"):
+            raise ValueError("write_mode must be 'rank0' or 'all', got %r"
+                             % (write_mode,))
+        self.mesh = mesh
+        self.write_mode = write_mode
+
+    def world_stamp(self):
+        import jax
+        stamp = {"process_count": jax.process_count()}
+        if self.mesh is not None:
+            stamp["mesh"] = {name: int(size) for name, size in
+                             zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape)}
+        return stamp
+
+    def save(self, step, saver):
+        from . import parallel
+        rank, _ = _rank_world()
+        path = self.path_for(step)
+        if self.write_mode == "all" or rank == 0:
+            def write():
+                saver(path)
+                _resilience.write_manifest(path, step=step,
+                                           world=self.world_stamp())
+
+            _resilience.call_with_retry(write, kind="ckpt_write")
+            _telemetry.counter("resilience.ckpt_saves").inc()
+        # nobody proceeds — and, on preemption, nobody EXITS — until the
+        # snapshot is fully published
+        parallel.barrier("mxtpu-elastic-ckpt-%d" % int(step))
+        if self.write_mode == "all" or rank == 0:
+            self._prune()
+        return path
+
+    def restore(self, loader):
+        import jax
+        rank, world = _rank_world()
+        restored = None
+        for step, path in reversed(self.checkpoints()):
+            try:
+                man = _resilience.verify_checkpoint(path,
+                                                    require_manifest=True)
+                if "world" not in man:
+                    raise _resilience.CheckpointCorruptError(
+                        "manifest %s has no world stamp — torn or "
+                        "uncoordinated write" % _resilience.manifest_path(
+                            path))
+                loader(path)
+            except _resilience.CheckpointCorruptError as exc:
+                _telemetry.counter("resilience.ckpt_fallbacks").inc()
+                _log("checkpoint %s unusable (%s); falling back", path, exc)
+                continue
+            restored = (step, man)
+            break
+        if world > 1:
+            import numpy as np
+            from . import parallel
+            # cross-rank agreement: a rank resuming a different step (or
+            # none) would silently fork the world
+            step_here = -1 if restored is None else int(restored[0])
+            lo = int(parallel.host_allreduce(np.int64(step_here)))
+            if lo != step_here * world:
+                raise _resilience.CheckpointCorruptError(
+                    "ranks disagree on the restore step (rank %d restored "
+                    "%s; cluster sum %d)" % (rank, step_here, lo))
+        if restored is None:
+            return None
+        step, man = restored
+        stamped = man["world"].get("process_count")
+        if stamped != jax.process_count():
+            # the single-file replicated format is world-portable; warn so
+            # a surprise resize is at least visible in the logs
+            _log("restoring a snapshot written by %s processes into a "
+                 "world of %d (elastic re-form)", stamped,
+                 jax.process_count())
+        return step
+
+
+def coordinate(manager, mesh=None):
+    """Upgrade a plain CheckpointManager to the coordinated protocol
+    (same directory/cadence/retention/prefix); pass-through when it
+    already is one."""
+    if isinstance(manager, CoordinatedCheckpointManager):
+        if mesh is not None and manager.mesh is None:
+            manager.mesh = mesh
+        return manager
+    return CoordinatedCheckpointManager(
+        manager.directory, every_n_steps=manager.every_n_steps,
+        keep=manager.keep, prefix=manager.prefix, mesh=mesh)

@@ -113,28 +113,63 @@ class KVStore:
         src/kvstore/kvstore_dist_server.h:349)."""
         if self.num_workers == 1:
             return val
+        # the simulated DCN failure point: before the hop, so the push()
+        # retry wrapper re-runs it without double-applying anything
+        _resilience.inject("dcn_push")
         from .parallel import host_allreduce
         return host_allreduce(val)
 
+    def _allreduce_codes(self, codes):
+        """Cross-process sum of 2-bit CODES over DCN: the wire carries the
+        PACKED form (4 codes/byte — 1/16 of the f32 bytes, reference
+        gradient_compression-inl.h quantize_2bit wire layout); each worker
+        unpacks the peers' rows and sums locally.  Value contract is
+        identical to ``_allreduce_dist`` on the unpacked codes."""
+        if self.num_workers == 1:
+            return codes
+        _resilience.inject("dcn_push")
+        from . import tracing as _tracing
+        from .parallel import host_allgather
+        from .parallel.compression import pack_2bit, unpack_2bit
+        shape, n = codes.shape, int(codes.size)
+        packed = pack_2bit(codes)
+        wire = int(packed.size)
+        _telemetry.counter("kvstore.compressed_bytes").inc(wire)
+        _telemetry.counter("kvstore.compressed_raw_bytes").inc(n * 4)
+        comp = _telemetry.counter("kvstore.compressed_bytes").value
+        raw = _telemetry.counter("kvstore.compressed_raw_bytes").value
+        if comp:
+            _telemetry.gauge("kvstore.compression_ratio").set(raw / comp)
+        with _tracing.span("allreduce_2bit", cat="collective"):
+            gathered = host_allgather(packed)
+        total = jnp.zeros(shape, jnp.int32)
+        for w in range(int(gathered.shape[0])):
+            total = total + unpack_2bit(gathered[w], n).reshape(shape)
+        return total
+
     def _compression_threshold(self):
         from . import config as _config
-        params = self._compression_params
+        params = self._compression_params or {}
         return float(params.get(
             "threshold", _config.get("kvstore.grad_compression_threshold")))
 
     def _compress(self, k, merged):
-        """2-bit quantization with per-key error feedback; returns int8
-        CODES so the cross-process hop moves 1/4 of the f32 bytes
-        (reference gradient_compression.cc; pack_2bit in
-        parallel/compression.py is the 1/16 wire form for transports that
-        cannot sum in flight).  Returns (payload, compressed_flag)."""
+        """2-bit quantization with per-key error feedback (reference
+        gradient_compression.cc); enabled by ``set_gradient_compression``
+        or the ``kvstore.grad_compress`` knob.  Returns ``(payload,
+        compressed_flag, new_residual)`` — the caller commits the
+        residual only AFTER the DCN hop succeeds, so a retried
+        ``dcn_push`` fault re-runs this bit-identically instead of
+        double-counting the quantization error."""
+        from . import config as _config
         params = getattr(self, "_compression_params", None)
-        if not params or params.get("type") != "2bit" or \
-                self.num_workers == 1:
-            return merged, False
+        ctype = (params or {}).get("type") or \
+            _config.get("kvstore.grad_compress")
+        if ctype != "2bit" or self.num_workers == 1:
+            return merged, False, None
         if self.num_workers > 127:
             # summed int8 codes would overflow the wire dtype
-            return merged, False
+            return merged, False, None
         from .parallel.compression import two_bit_compress
         thr = self._compression_threshold()
         if not hasattr(self, "_residuals"):
@@ -142,8 +177,8 @@ class KVStore:
         res = self._residuals.get(k)
         if res is None:
             res = jnp.zeros_like(merged)
-        codes, self._residuals[k] = two_bit_compress(merged, res, thr)
-        return codes, True
+        codes, new_res = two_bit_compress(merged, res, thr)
+        return codes, True, new_res
 
     def push(self, key, value, priority=0):
         """Pushes (aggregates) value(s) into the store
@@ -165,14 +200,16 @@ class KVStore:
     def _push_impl(self, keys, values):
         for k, v in zip(keys, values):
             merged = self._merge(v)
-            payload, compressed = self._compress(k, merged)
-            reduced = self._allreduce_dist(payload)
+            payload, compressed, new_res = self._compress(k, merged)
             if compressed:
+                reduced = self._allreduce_codes(payload)
+                # commit the error feedback only once the hop succeeded
+                self._residuals[k] = new_res
                 # sum(codes) * threshold == sum of decompressed gradients
                 merged = reduced.astype(merged.dtype) * \
                     self._compression_threshold()
             else:
-                merged = reduced
+                merged = self._allreduce_dist(payload)
             if self._updater is not None:
                 self._updater(_key_int(k), _wrap(merged), self._store[k])
             else:

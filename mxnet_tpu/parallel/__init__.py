@@ -33,7 +33,7 @@ __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "PartitionSpec", "ring_attention", "attention",
            "ring_self_attention_sharded", "functionalize", "BlockFunction",
            "SPMDTrainer", "build_train_step", "host_allreduce",
-           "initialize", "ensure_initialized", "barrier",
+           "host_allgather", "initialize", "ensure_initialized", "barrier",
            "pipeline_apply", "pipeline_sharded", "microbatch",
            "unmicrobatch", "moe_ffn", "moe_ffn_sharded", "top_k_routing",
            "ShardedEmbedding", "dedup_ids", "lookup_unique",
@@ -75,16 +75,81 @@ def ensure_initialized():
         initialize()
 
 
+# ---- coordination-service transport -----------------------------------
+# XLA cross-process collectives need a real interconnect backend; the CPU
+# backend has none ("Multiprocess computations aren't implemented"), so on
+# CPU the host collectives ride the jax.distributed coordination service
+# instead — the same gRPC KV store that did the rendezvous.  Slower, but
+# value-exact and deterministic (rows are summed in rank order), which is
+# what the dist tests and the elastic chaos harness need.
+
+_COORD_TIMEOUT_MS = 120_000
+_COORD_SEQ = {"allreduce": 0, "barrier": 0}  # advances in SPMD order
+
+
+def _coord_client():
+    from jax._src import distributed as _dist
+    return getattr(_dist.global_state, "client", None)
+
+
+def _use_coord_transport():
+    return jax.default_backend() == "cpu" and _coord_client() is not None
+
+
+def _kv_allgather(arr):
+    """Allgather host rows through the coordination-service KV store.
+
+    Every collective is one sequence number; all ranks execute collectives
+    in the same program order, so the counter agrees without negotiation.
+    A rank that reached seq N has read every row of seq N-1, so each rank
+    deletes its own seq N-2 key on entry — the store holds O(world) live
+    keys, not O(steps)."""
+    import numpy as np
+    client = _coord_client()
+    rank, world = jax.process_index(), jax.process_count()
+    _COORD_SEQ["allreduce"] += 1
+    seq = _COORD_SEQ["allreduce"]
+    if seq > 2:
+        try:
+            client.key_value_delete("mxtpu/ar/%d/%d" % (seq - 2, rank))
+        except Exception:  # already gone / server restarted — harmless
+            pass
+    client.key_value_set_bytes("mxtpu/ar/%d/%d" % (seq, rank),
+                               arr.tobytes())
+    rows = []
+    for peer in range(world):
+        buf = client.blocking_key_value_get_bytes(
+            "mxtpu/ar/%d/%d" % (seq, peer), _COORD_TIMEOUT_MS)
+        rows.append(np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape))
+    return np.stack(rows)
+
+
+def host_allgather(val):
+    """Stack a host-local array across all processes (world, *shape) — the
+    DCN gather primitive under host_allreduce and the kvstore's 2-bit
+    compressed wire."""
+    import numpy as np
+    if jax.process_count() == 1:
+        return jnp.asarray(val)[None]
+    from .. import tracing as _tracing
+    with _tracing.span("allgather", cat="collective"):
+        if _use_coord_transport():
+            # NB: no ascontiguousarray — it promotes 0-d scalars to 1-d
+            # and would change the gathered shape; tobytes() copies
+            # non-contiguous inputs itself
+            return jnp.asarray(_kv_allgather(np.asarray(val)))
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(jnp.asarray(val))
+
+
 def host_allreduce(val):
     """Sum a host-local array across all processes (DCN allreduce) — the
     dist_sync server-merge analog (src/kvstore/kvstore_dist_server.h:349)."""
     if jax.process_count() == 1:
         return val
-    from jax.experimental import multihost_utils
     from .. import tracing as _tracing
     with _tracing.span("allreduce", cat="collective"):
-        gathered = multihost_utils.process_allgather(jnp.asarray(val))
-        return jnp.sum(gathered, axis=0)
+        return jnp.sum(host_allgather(val), axis=0)
 
 
 def barrier(name="kvstore"):
@@ -92,7 +157,13 @@ def barrier(name="kvstore"):
     include/mxnet/kvstore.h:300)."""
     if jax.process_count() == 1:
         return
-    from jax.experimental import multihost_utils
     from .. import tracing as _tracing
     with _tracing.span("barrier", cat="collective", name_arg=name):
+        if _use_coord_transport():
+            _COORD_SEQ["barrier"] += 1
+            _coord_client().wait_at_barrier(
+                "mxtpu/bar/%d/%s" % (_COORD_SEQ["barrier"], name),
+                _COORD_TIMEOUT_MS)
+            return
+        from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)

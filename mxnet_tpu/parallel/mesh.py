@@ -14,6 +14,9 @@ Axis conventions (used across mxnet_tpu.parallel and mxnet_tpu.models):
   'sp'  sequence/context par.  (ring attention over sequence blocks)
   'pp'  pipeline parallel      (layer stages)
   'ep'  expert parallel        (MoE experts)
+  'dcn' data-center network    (cross-slice/host hop — the slow axis;
+                               gradient sync over it may be 2-bit
+                               compressed, see parallel/compression.py)
 """
 from __future__ import annotations
 
@@ -27,7 +30,7 @@ __all__ = ["AXES", "make_mesh", "data_parallel_mesh", "sharding",
            "shard_batch", "replicated", "local_mesh_devices",
            "PartitionSpec", "Mesh", "NamedSharding"]
 
-AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep", "dcn")
 
 
 def local_mesh_devices(n=None):

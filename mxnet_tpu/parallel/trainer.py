@@ -148,6 +148,48 @@ class SPMDTrainer:
         from . import embedding as _pemb
         self._sparse_embed = _pemb.sparse_embedding_params(
             self.fn, self.mesh, self.batch_axis)
+        # compressed DCN sync (kvstore.grad_compress=2bit): per-param
+        # error-feedback residuals, sharded P('dcn') and donated through
+        # the step like optimizer state; None until the first compressed
+        # step materializes them (or a checkpoint restores them)
+        self._dcn_residuals = None
+
+    # ------------------------------------------------- compressed DCN sync
+    def _dcn_compress_active(self, pad=0):
+        """True when this trainer's fused step should quantize the DCN
+        gradient hop: the 2-bit knob is on AND the mesh declares a 'dcn'
+        axis.  Pad-masked steps run uncompressed (the tail mask reduces
+        over the global batch; under shard_map it would be shard-local),
+        as do sparse-embedding models (row-sparse updates never cross
+        DCN whole)."""
+        from .. import config as _cfg
+        if _cfg.get("kvstore.grad_compress") != "2bit":
+            return False
+        if "dcn" not in self.mesh.axis_names:
+            return False
+        if pad:
+            return False
+        if any(n in self._sparse_embed for n in self.fn.trainable):
+            return False
+        return True
+
+    def _dcn_check(self):
+        """Refuse configurations where the compressed path would silently
+        compute the wrong thing instead of a smaller wire."""
+        extra = [a for a in self.mesh.axis_names
+                 if a not in ("dcn", self.batch_axis)]
+        if extra:
+            raise NotImplementedError(
+                "kvstore.grad_compress=2bit supports data-parallel meshes "
+                "('dcn' + the batch axis); this mesh also has axes %s"
+                % (extra,))
+        bad = [n for n in list(self.fn.trainable) + list(self.fn.aux)
+               if len(self._spec_for(n)) > 0]
+        if bad:
+            raise NotImplementedError(
+                "compressed DCN sync needs replicated parameters (each "
+                "gradient is quantized whole); sharded specs on %s"
+                % bad[:4])
 
     def _materialize(self, data):
         """Snapshot the Block's parameters into device-placed jax arrays.
@@ -262,7 +304,16 @@ class SPMDTrainer:
         never issues a synchronous ``device_put``."""
         sh = getattr(self, "_batch_sharding", None)
         if sh is None:
-            sh = NamedSharding(self.mesh, P(self.batch_axis))
+            if "dcn" in self.mesh.axis_names and self.batch_axis != "dcn":
+                # the global batch also splits over the slow axis: each
+                # dcn slice computes grads for its own rows and the dcn
+                # hop (full psum, or 2-bit codes under grad_compress)
+                # merges them — without this, every slice would redo the
+                # whole batch
+                spec = P((self.batch_axis, "dcn"))
+            else:
+                spec = P(self.batch_axis)
+            sh = NamedSharding(self.mesh, spec)
             self._batch_sharding = sh
         return sh
 
@@ -331,15 +382,82 @@ class SPMDTrainer:
         if fused_opt:
             _kernels.note_fused_step()
 
-        def step(train_params, aux_params, opt_state, data, label, key, t,
-                 lrs, wds, lr_scale, streak=None):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_params, aux_params, data, label,
-                                       key)
-            if instrument:
-                new_aux, _, stats = aux
+        # compressed DCN gradient sync (docs/RESILIENCE.md "Multi-host
+        # elasticity"): grads crossing the 'dcn' mesh axis ride as packed
+        # 2-bit codes with per-param error-feedback residuals carried as
+        # donated step state; ICI axes keep the full-precision psum.  The
+        # numerics-instrumented variant always runs uncompressed so
+        # forensics sees the raw math.
+        compress = (not instrument) and self._dcn_compress_active(pad)
+        grad_fn = None
+        if compress:
+            self._dcn_check()
+            import math as _math
+            from .. import config as _cfg2
+            from .pipeline import shmap
+            from . import compression as _comp
+            thr = float(_cfg2.get("kvstore.grad_compression_threshold"))
+            n_dcn = int(mesh.shape["dcn"])
+            n_shards = int(_math.prod(mesh.devices.shape))
+            ici_axes = tuple(a for a in mesh.axis_names if a != "dcn")
+            all_axes = tuple(mesh.axis_names)
+
+            def sync_grads(train_params, aux_params, residuals, data,
+                           label, key):
+                # per-shard: grads of the LOCAL rows' mean loss
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_params, aux_params, data,
+                                           label, key)
+                new_aux = aux[0]
+                out_g, new_res = {}, {}
+                for n in trainable:
+                    g = grads[n]
+                    if ici_axes:
+                        # ICI stays full precision: compiler-scheduled
+                        # psum at torus bandwidth beats recompression
+                        g = jax.lax.psum(g, ici_axes)
+                    # this dcn slice's share of the GLOBAL mean gradient
+                    # (the dcn-psum of v is the uncompressed global grad)
+                    v = g / n_shards
+                    codes, r = _comp.two_bit_compress(v, residuals[n][0],
+                                                      thr)
+                    packed = _comp.pack_2bit(codes)
+                    # the DCN hop moves 4 codes/byte — 1/16 of the f32
+                    # bytes; each shard unpacks the peers' rows and sums
+                    rows = jax.lax.all_gather(packed, "dcn")
+                    tot = jnp.zeros((int(v.size),), jnp.int32)
+                    for w in range(n_dcn):
+                        tot = tot + _comp.unpack_2bit(rows[w], int(v.size))
+                    out_g[n] = (tot.astype(v.dtype) * thr).reshape(v.shape)
+                    new_res[n] = r[None]
+                loss = jax.lax.pmean(loss, all_axes)
+                new_aux = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, all_axes)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                    else a, new_aux)
+                return loss, new_aux, out_g, new_res
+
+            bspec = batch_sh.spec
+            grad_fn = shmap(
+                sync_grads, mesh,
+                in_specs=(P(), P(), P("dcn"), bspec, bspec, P()),
+                out_specs=(P(), P(), P(), P("dcn")))
+
+        def _step_body(train_params, aux_params, opt_state, residuals,
+                       data, label, key, t, lrs, wds, lr_scale, streak):
+            if compress:
+                loss, new_aux, grads, new_res = grad_fn(
+                    train_params, aux_params, residuals, data, label, key)
+                stats = None
             else:
-                (new_aux, _), stats = aux, None
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_params, aux_params, data,
+                                           label, key)
+                if instrument:
+                    new_aux, _, stats = aux
+                else:
+                    (new_aux, _), stats = aux, None
+                new_res = None
             new_params = {}
             new_state = {}
             from .. import random as _random
@@ -375,9 +493,11 @@ class SPMDTrainer:
             aux_out = dict(aux_params)
             aux_out.update(new_aux)
             if not guard:
+                outs = (new_params, aux_out, new_state) \
+                    + ((new_res,) if compress else ()) + (loss,)
                 if stats is not None:
-                    return new_params, aux_out, new_state, loss, stats
-                return new_params, aux_out, new_state, loss
+                    outs += (stats,)
+                return outs
             # nanguard (docs/RESILIENCE.md): all on-device — a bad step
             # keeps the pre-step params/state/aux (the update is computed
             # then deselected; XLA still fuses it into one program) and the
@@ -390,10 +510,30 @@ class SPMDTrainer:
                                                  train_params)
             new_state = _resilience.select_tree(finite, new_state, opt_state)
             aux_out = _resilience.select_tree(finite, aux_out, aux_params)
+            if compress:
+                # a rolled-back step must also roll back its quantization
+                # error, or the next step double-counts the bad residual
+                new_res = _resilience.select_tree(finite, new_res, residuals)
+            outs = (new_params, aux_out, new_state) \
+                + ((new_res,) if compress else ()) + (loss, new_streak)
             if stats is not None:
-                return (new_params, aux_out, new_state, loss, new_streak,
-                        stats)
-            return new_params, aux_out, new_state, loss, new_streak
+                outs += (stats,)
+            return outs
+
+        if compress:
+            def step(train_params, aux_params, opt_state, residuals, data,
+                     label, key, t, lrs, wds, lr_scale, streak=None):
+                return _step_body(train_params, aux_params, opt_state,
+                                  residuals, data, label, key, t, lrs, wds,
+                                  lr_scale, streak)
+            donate = (0, 2, 3) if self._donate else ()
+        else:
+            def step(train_params, aux_params, opt_state, data, label, key,
+                     t, lrs, wds, lr_scale, streak=None):
+                return _step_body(train_params, aux_params, opt_state, None,
+                                  data, label, key, t, lrs, wds, lr_scale,
+                                  streak)
+            donate = (0, 2) if self._donate else ()
 
         # Sharding is carried by the arguments themselves (params were
         # device_put with their NamedShardings in _place(); the batch is
@@ -402,7 +542,6 @@ class SPMDTrainer:
         # (src/kvstore/comm.h:451) becomes one compiler-scheduled psum.
         self._batch_sharding = batch_sh
         del param_sh
-        donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     def _build_sparse(self, pad, sparse_meta, instrument=False):
@@ -611,6 +750,8 @@ class SPMDTrainer:
             pkey += "/at%d" % self._autotune_gen
         if instrument:
             pkey += "/numerics"
+        elif self._dcn_compress_active(pad):
+            pkey += "/dcn2bit"
         with _tracing.span("spmd.compile", cat="spmd"):
             jitted = self._jitted[(pad, ntok)] = _perf.wrap(
                 self._build(pad, instrument=instrument), "spmd", pkey,
@@ -664,6 +805,13 @@ class SPMDTrainer:
         if self._ckpt_manager is not None:
             self._ckpt_manager.maybe_save(self._step_num,
                                           self.save_checkpoint)
+        from .. import elastic as _elastic
+        if _elastic.active():
+            # multi-host lockstep: a SIGTERM on ANY rank (or an injected
+            # peer_preempt) makes EVERY rank adopt the request at this
+            # same step boundary, so the coordinated checkpoint below
+            # snapshots one consistent world
+            _elastic.maybe_cluster_preempt(self._step_num)
         if _resilience.preempt_requested():
             # the in-flight step is done (save gathers to host, which
             # syncs); checkpoint, flush sinks, exit 0
@@ -707,6 +855,12 @@ class SPMDTrainer:
         # epoch-neutral in config.py)
         from .. import numerics as _numerics
         cap = _numerics.should_capture("spmd")
+        compressed = (not cap) and self._dcn_compress_active(pad)
+        if self._dcn_residuals is not None \
+                and not self._dcn_compress_active(0):
+            # knob turned off: stale error feedback must not leak into a
+            # later re-enable (mirrors set_gradient_compression's reset)
+            self._dcn_residuals = None
         jitted = self._program(pad, instrument=cap)
         # the batch shard_put is the host->mesh boundary; the gradient
         # allreduce itself is a compiler-scheduled psum INSIDE the jitted
@@ -743,7 +897,18 @@ class SPMDTrainer:
             if cacheable and len(scales) < 16:
                 scales[lr_scale] = sarr
         t_arr = jnp.asarray(self._step_num, jnp.int32)
+        if compressed and self._dcn_residuals is None:
+            n_dcn = int(self.mesh.shape["dcn"])
+            rsh = NamedSharding(self.mesh, P("dcn"))
+            self._dcn_residuals = {
+                n: jax.device_put(
+                    jnp.zeros((n_dcn,) + tuple(train[n].shape),
+                              train[n].dtype if jnp.issubdtype(
+                                  train[n].dtype, jnp.inexact)
+                              else jnp.float32), rsh)
+                for n in train}
         args = (train, aux, self.opt_state) + \
+            ((self._dcn_residuals,) if compressed else ()) + \
             ((tables,) if sparse else ()) + (data, label, key, t_arr, lrs,
                                              wds, sarr)
         stats = None
@@ -754,6 +919,9 @@ class SPMDTrainer:
             if cap:
                 (new_train, new_aux, self.opt_state, loss,
                  self._nan_streak, stats) = res
+            elif compressed:
+                (new_train, new_aux, self.opt_state, self._dcn_residuals,
+                 loss, self._nan_streak) = res
             else:
                 new_train, new_aux, self.opt_state, loss, \
                     self._nan_streak = res
@@ -788,8 +956,28 @@ class SPMDTrainer:
             res = jitted(*args)
             if cap:
                 new_train, new_aux, self.opt_state, loss, stats = res
+            elif compressed:
+                (new_train, new_aux, self.opt_state, self._dcn_residuals,
+                 loss) = res
             else:
                 new_train, new_aux, self.opt_state, loss = res
+        if compressed:
+            # static wire accounting (no device sync): each step's DCN hop
+            # carries the packed codes — 4 per byte vs 4 bytes per f32
+            wire = getattr(self, "_dcn_wire", None)
+            if wire is None:
+                packed = sum((int(v.size) + 3) // 4
+                             for v in new_train.values())
+                raw_b = sum(int(v.size) * 4 for v in new_train.values())
+                wire = self._dcn_wire = (packed, raw_b)
+            from .. import telemetry as _telemetry
+            _telemetry.counter("kvstore.compressed_bytes").inc(wire[0])
+            _telemetry.counter("kvstore.compressed_raw_bytes").inc(wire[1])
+            comp = _telemetry.counter("kvstore.compressed_bytes").value
+            raw = _telemetry.counter("kvstore.compressed_raw_bytes").value
+            if comp:
+                _telemetry.gauge("kvstore.compression_ratio").set(
+                    raw / comp)
         if stats is not None:
             # device stats enter the pending queue; drained by the
             # is-ready poll later — zero host sync on this thread
@@ -824,7 +1012,15 @@ class SPMDTrainer:
         nanguard abort path writes a last-good checkpoint.  With
         ``auto_resume`` (default) the newest GOOD checkpoint is restored
         immediately — a corrupt/truncated newest file is skipped for the
-        last good one.  Returns the resumed step, or None on cold start."""
+        last good one.  Returns the resumed step, or None on cold start.
+
+        In a multi-process world a plain CheckpointManager is upgraded to
+        the coordinated protocol (``elastic.CoordinatedCheckpointManager``:
+        rank 0 writes + world-stamped manifest + all-ranks barrier) — an
+        uncoordinated save from N ranks into one directory would race."""
+        if jax.process_count() > 1:
+            from .. import elastic as _elastic
+            manager = _elastic.coordinate(manager, mesh=self.mesh)
         self._ckpt_manager = manager
         if auto_resume:
             return manager.restore(self.load_checkpoint)
@@ -963,6 +1159,11 @@ class SPMDTrainer:
             # bitwise-continue guarantee to hold.
             "rng_key": np.asarray(rng_key),
         }
+        if self._dcn_residuals is not None:
+            # compressed-DCN error feedback rides along so a resumed run
+            # continues the quantized trajectory bitwise
+            host["dcn_residuals"] = {n: _to_host(v) for n, v in
+                                     self._dcn_residuals.items()}
         # atomic publish: a crash mid-write leaves the previous checkpoint
         # under `path`, never a truncated pickle (docs/RESILIENCE.md)
         with _resilience.atomic_write(path, "wb") as f:
@@ -1007,6 +1208,17 @@ class SPMDTrainer:
         if "rng_key" in host:
             _random._STATE.key = jnp.asarray(host["rng_key"])
         self._nan_streak = None  # restored params are finite by definition
+        self._dcn_residuals = None
+        dres = host.get("dcn_residuals")
+        if dres and "dcn" in self.mesh.axis_names:
+            n_dcn = int(self.mesh.shape["dcn"])
+            if all(v.shape[0] == n_dcn for v in dres.values()):
+                rsh = NamedSharding(self.mesh, P("dcn"))
+                self._dcn_residuals = {
+                    n: jax.device_put(jnp.asarray(v), rsh)
+                    for n, v in dres.items()}
+            # a re-formed world with a different dcn extent restarts the
+            # error feedback from zero (first compressed step re-inits)
         return self._step_num
 
 

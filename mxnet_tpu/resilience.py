@@ -176,14 +176,21 @@ def _crc32_file(path):
     return crc & 0xFFFFFFFF, size
 
 
-def write_manifest(path, step=None):
+def write_manifest(path, step=None, world=None):
     """Write the integrity sidecar ``<path>.manifest.json`` (CRC32 + size
-    + schema version) for an already-published checkpoint file."""
+    + schema version) for an already-published checkpoint file.
+
+    ``world`` (optional dict, e.g. ``{"process_count": 4, "mesh":
+    {"dcn": 2, "dp": 2}}``) stamps the multi-host shape the snapshot was
+    coordinated under; ``elastic.CoordinatedCheckpointManager.restore``
+    refuses snapshots without it (torn-write guard)."""
     crc, size = _crc32_file(path)
     man = {"schema": MANIFEST_SCHEMA, "file": os.path.basename(path),
            "size": size, "crc32": crc, "ts": round(time.time(), 3)}
     if step is not None:
         man["step"] = int(step)
+    if world is not None:
+        man["world"] = dict(world)
     with atomic_write(manifest_path(path), "w") as f:
         json.dump(man, f)
     return man
@@ -392,6 +399,22 @@ def _on_preempt_signal(signum, frame):
 def preempt_requested():
     """Cheap per-step poll: has a preemption signal arrived?"""
     return _PREEMPT["signum"] is not None
+
+
+def request_preempt(signum=signal.SIGTERM):
+    """Programmatic preemption notice — same effect as receiving SIGTERM.
+
+    Used by ``mx.elastic`` when the cluster agreement says a PEER was
+    preempted (every rank must finish the in-flight step and checkpoint
+    together) and by the deterministic ``peer_preempt`` fault kind."""
+    if _PREEMPT["signum"] is None:
+        _PREEMPT["signum"] = int(signum)
+        _telemetry().counter("resilience.preemptions").inc()
+        try:
+            from . import tracing
+            tracing.record_event("preempt", "requested_%d" % int(signum))
+        except Exception:  # noqa: BLE001 — telemetry must not break this
+            pass
 
 
 def clear_preempt():

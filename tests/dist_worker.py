@@ -125,6 +125,14 @@ def _compression_phase(mx, kv, rank, nworker):
     zero_rounds = 4 - fired
     assert zero_rounds >= 1, \
         "expected at least one zero-emission round for threshold 0.5/0.3"
+    # the wire really carried the packed form: telemetry from the
+    # _allreduce_codes hop must show >= 8x reduction vs f32 bytes
+    # (2-bit packing is exactly 16x on whole words)
+    from mxnet_tpu import telemetry
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("kvstore.compressed_bytes", 0) > 0, snap
+    ratio = snap["gauges"].get("kvstore.compression_ratio", 0.0)
+    assert ratio >= 8.0, "compression_ratio %.2f < 8x" % ratio
 
 
 if __name__ == "__main__":

@@ -1,0 +1,106 @@
+"""Unit coverage for ``parallel/compression.py`` (2-bit error feedback).
+
+Pins the wire contract the compressed DCN path depends on: {-1,0,+1}
+code domain, 4-elements-per-byte packing, exact roundtrip at sizes not
+divisible by 4, and error-feedback unbiasedness (compressed SGD with a
+residual converges to within tolerance of uncompressed SGD).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel.compression import (
+    pack_2bit, two_bit_compress, two_bit_decompress, unpack_2bit)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 13, 64, 101])
+def test_pack_unpack_roundtrip_any_size(n):
+    rng = np.random.RandomState(n)
+    codes = rng.randint(-1, 2, size=n).astype(np.int8)
+    packed = np.asarray(pack_2bit(codes))
+    assert packed.dtype == np.uint8
+    assert packed.shape == ((n + 3) // 4,)
+    back = np.asarray(unpack_2bit(packed, n))
+    assert back.dtype == np.int8
+    np.testing.assert_array_equal(back, codes)
+
+
+def test_wire_format_width_is_4_elems_per_byte():
+    # 16 elements -> exactly 4 wire bytes: 1/16 of the f32 footprint
+    codes = np.array([1, -1, 0, 1] * 4, np.int8)
+    packed = np.asarray(pack_2bit(codes))
+    assert packed.nbytes == 4
+    assert codes.size * 4 // packed.nbytes == 16  # f32 bytes / wire bytes
+
+
+def test_code_domain_and_threshold_bands():
+    thr = 0.5
+    grad = np.array([-2.0, -0.5, -0.49, 0.0, 0.49, 0.5, 2.0], np.float32)
+    codes, new_res = two_bit_compress(grad, np.zeros_like(grad), thr)
+    codes = np.asarray(codes)
+    assert codes.dtype == np.int8
+    assert set(np.unique(codes)) <= {-1, 0, 1}
+    np.testing.assert_array_equal(codes, [-1, -1, 0, 0, 0, 1, 1])
+    # residual is exactly what the quantization dropped
+    dec = np.asarray(two_bit_decompress(codes, thr))
+    np.testing.assert_allclose(np.asarray(new_res), grad - dec, rtol=0,
+                               atol=0)
+
+
+def test_multid_shapes_roundtrip():
+    rng = np.random.RandomState(0)
+    g = rng.randn(3, 5).astype(np.float32)
+    codes, res = two_bit_compress(g, np.zeros_like(g), 0.3)
+    assert np.asarray(codes).shape == (3, 5)
+    assert np.asarray(res).shape == (3, 5)
+    flat = np.asarray(unpack_2bit(pack_2bit(codes), g.size)).reshape(3, 5)
+    np.testing.assert_array_equal(flat, np.asarray(codes))
+
+
+def test_error_feedback_sgd_converges_like_uncompressed():
+    # tiny quadratic: f(w) = 0.5 ||A w - b||^2 / m
+    rng = np.random.RandomState(7)
+    d, m = 8, 64
+    A = rng.randn(m, d).astype(np.float32)
+    w_star = rng.randn(d).astype(np.float32)
+    b = A @ w_star
+
+    def grad(w):
+        return (A.T @ (A @ w - b)) / m
+
+    def loss(w):
+        r = A @ w - b
+        return float(0.5 * np.mean(r * r))
+
+    # threshold ABOVE every raw gradient magnitude: without the residual
+    # no element ever fires, so any progress is error feedback at work
+    thr = float(2.0 * np.abs(grad(np.zeros(d, np.float32))).max())
+    steps = 800
+    w_u = np.zeros(d, np.float32)
+    w_c = np.zeros(d, np.float32)
+    w_n = np.zeros(d, np.float32)   # compressed, residual dropped
+    res = np.zeros(d, np.float32)
+    zero = np.zeros(d, np.float32)
+    for t in range(steps):
+        lr = 0.05 / (1 + 0.01 * t)
+        w_u = w_u - lr * grad(w_u)
+        codes, res = two_bit_compress(grad(w_c), res, thr)
+        res = np.asarray(res)
+        w_c = w_c - lr * np.asarray(two_bit_decompress(codes, thr))
+        cn, _ = two_bit_compress(grad(w_n), zero, thr)
+        w_n = w_n - lr * np.asarray(two_bit_decompress(cn, thr))
+    l0, lu, lc, ln = (loss(np.zeros(d, np.float32)), loss(w_u),
+                      loss(w_c), loss(w_n))
+    assert lu < 1e-4 * l0          # sanity: uncompressed converged
+    # error feedback keeps the compressed trajectory within tolerance of
+    # the uncompressed one; dropping the residual stalls completely
+    assert lc < lu + 1e-3 * l0
+    assert ln == pytest.approx(l0)
+
+
+def test_zero_grad_emits_zero_codes_and_keeps_residual():
+    thr = 0.5
+    g = np.zeros(6, np.float32)
+    res_in = np.full(6, 0.3, np.float32)
+    codes, res = two_bit_compress(g, res_in, thr)
+    assert not np.any(np.asarray(codes))
+    np.testing.assert_allclose(np.asarray(res), res_in)

@@ -1,0 +1,312 @@
+"""mx.elastic — preemption-tolerant multi-host training (single-process
+legs; the 2-process protocol is proven end-to-end by
+tools/check_dist_chaos.py via tests/test_dist_chaos.py).
+
+Covers: the coordinated checkpoint world stamp and the torn-snapshot
+refusal, heartbeat lease expiry, the cluster preemption agreement fed by
+the deterministic ``peer_preempt`` fault, the ``kvstore.grad_compress``
+knob contract, and the compressed-DCN fused train step (wire telemetry,
+error-feedback residuals as donated opt-state, checkpoint round-trip,
+nanguard rollback).
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, elastic, resilience, telemetry
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.gluon.loss as gloss
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.trainer import SPMDTrainer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- coordinated checkpoints
+def _saver_of(payload):
+    def save(path):
+        with resilience.atomic_write(path, "wb") as f:
+            import pickle
+            pickle.dump(payload, f)
+    return save
+
+
+def test_manifest_world_stamp_roundtrip(tmp_path):
+    path = str(tmp_path / "x.ckpt")
+    _saver_of({"step": 3})(path)
+    resilience.write_manifest(path, step=3,
+                              world={"process_count": 4,
+                                     "mesh": {"dcn": 2, "dp": 2}})
+    man = resilience.verify_checkpoint(path, require_manifest=True)
+    assert man["world"] == {"process_count": 4, "mesh": {"dcn": 2, "dp": 2}}
+
+
+def test_coordinated_manager_save_restore(tmp_path):
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    mgr = elastic.CoordinatedCheckpointManager(
+        str(tmp_path), every_n_steps=2, keep=2, mesh=mesh)
+    seen = {}
+
+    def load(path):
+        import pickle
+        with open(path, "rb") as f:
+            seen.update(pickle.load(f))
+
+    assert mgr.restore(load) is None          # cold start
+    for step in (2, 4):
+        mgr.maybe_save(step, _saver_of({"step": step}))
+    assert mgr.restore(load) == 4
+    assert seen["step"] == 4
+    man = resilience.verify_checkpoint(mgr.path_for(4),
+                                       require_manifest=True)
+    assert man["world"]["process_count"] == 1
+    assert man["world"]["mesh"] == {"dp": 2}
+
+
+def test_restore_refuses_unstamped_snapshot(tmp_path):
+    """A snapshot whose manifest lacks the world stamp is, by protocol, a
+    torn or uncoordinated write: restore must skip it (fall back), never
+    seed a resumed run from it."""
+    plain = resilience.CheckpointManager(str(tmp_path), every_n_steps=1,
+                                         keep=3)
+    plain.save(7, _saver_of({"step": 7}))     # manifest without world
+    coord = elastic.CoordinatedCheckpointManager(str(tmp_path),
+                                                 every_n_steps=1, keep=3)
+    before = telemetry.counter("resilience.ckpt_fallbacks").value
+    assert coord.restore(lambda p: None) is None
+    assert telemetry.counter("resilience.ckpt_fallbacks").value > before
+    coord.save(9, _saver_of({"step": 9}))     # stamped — now restorable
+    assert coord.restore(lambda p: None) == 9
+
+
+def test_coordinate_upgrades_plain_manager(tmp_path):
+    plain = resilience.CheckpointManager(str(tmp_path), every_n_steps=5,
+                                         keep=2, prefix="run")
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    up = elastic.coordinate(plain, mesh=mesh)
+    assert isinstance(up, elastic.CoordinatedCheckpointManager)
+    assert (up.directory, up.every_n_steps, up.keep, up.prefix) == \
+        (plain.directory, 5, 2, "run")
+    assert elastic.coordinate(up) is up       # idempotent
+
+
+# ------------------------------------------------------- heartbeat / lease
+def test_heartbeat_lease_expiry_flag_mode(tmp_path):
+    config.set("elastic.on_peer_loss", "flag")
+    try:
+        hb = elastic.HeartbeatMonitor(str(tmp_path), rank=0, world=2,
+                                      interval_s=0.05)
+        # fabricate a peer whose lease is already stale
+        stale = str(tmp_path / "hb-r1")
+        with open(stale, "w") as f:
+            f.write("1 0.0\n")
+        old = time.time() - 60.0
+        os.utime(stale, (old, old))
+        before = telemetry.counter("elastic.peer_lease_expired").value
+        hb.start()
+        try:
+            deadline = time.time() + 5.0
+            while not hb.peer_lost() and time.time() < deadline:
+                time.sleep(0.02)
+        finally:
+            hb.stop()
+        assert 1 in hb.peer_lost()
+        assert hb.peer_lost()[1] > hb.lease_s
+        assert telemetry.counter("elastic.peer_lease_expired").value > before
+        assert os.path.exists(str(tmp_path / "hb-r0"))  # own lease renewed
+    finally:
+        config.set("elastic.on_peer_loss", "abort")
+
+
+# ------------------------------------------- cluster preemption agreement
+def test_peer_preempt_fault_triggers_agreement(tmp_path):
+    config.set("elastic.dir", str(tmp_path))
+    config.set("resilience.faults", "peer_preempt:1@step=3")
+    try:
+        assert not elastic.maybe_cluster_preempt(step=1)
+        assert not elastic.maybe_cluster_preempt(step=2)
+        assert not resilience.preempt_requested()
+        assert elastic.maybe_cluster_preempt(step=3)
+        # the agreement adopted the request and dropped the restart flag
+        assert resilience.preempt_requested()
+        assert elastic.preempt_announced()
+        flag = str(tmp_path / "preempt-r0")
+        with open(flag) as f:
+            payload = json.load(f)
+        assert payload["step"] == 3 and payload["generation"] == 0
+        elastic.announce_preempt(step=3)      # idempotent
+        elastic.clear_flags()
+        assert not elastic.preempt_announced()
+    finally:
+        config.set("resilience.faults", "")
+        config.set("elastic.dir", "")
+        resilience.clear_preempt()
+        elastic.stop_heartbeat()
+
+
+def test_inactive_elastic_is_noop():
+    assert not elastic.active()
+    assert not elastic.maybe_cluster_preempt(step=1)
+    with pytest.raises(ValueError, match="elastic.dir"):
+        elastic.state_dir()
+
+
+# ------------------------------------------------------------ config knob
+def test_grad_compress_knob_rejects_and_reverts():
+    with pytest.raises(ValueError, match="2bit"):
+        config.set("kvstore.grad_compress", "lz4")
+    assert config.get("kvstore.grad_compress") == ""
+    config.set("kvstore.grad_compress", "2bit")
+    try:
+        assert config.get("kvstore.grad_compress") == "2bit"
+    finally:
+        config.set("kvstore.grad_compress", "")
+
+
+# ------------------------------------------------- compressed DCN trainer
+def _dcn_trainer(prefix):
+    mx.random.seed(42)
+    net = nn.Dense(4, in_units=16, prefix=prefix)
+    net.initialize()
+    return SPMDTrainer(net, gloss.L2Loss(), "sgd",
+                       {"learning_rate": 0.1},
+                       mesh=make_mesh({"dcn": 2, "dp": 4}))
+
+
+def _batches(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(8, 16).astype("f4"), rng.randn(8, 4).astype("f4"))
+            for _ in range(n)]
+
+
+def test_compressed_dcn_step_converges_and_reports_wire(tmp_path):
+    batches = _batches(6)
+    tr0 = _dcn_trainer("unc_")
+    base = [float(tr0.step(x, y)) for x, y in batches]
+    config.set("kvstore.grad_compress", "2bit")
+    config.set("kvstore.grad_compression_threshold", 0.05)
+    try:
+        tr = _dcn_trainer("cmp_")
+        before = telemetry.counter("kvstore.compressed_bytes").value
+        comp = [float(tr.step(x, y)) for x, y in batches]
+        # the first loss is computed from identical params on identical
+        # data — compression only changes the update
+        assert comp[0] == pytest.approx(base[0], rel=1e-5)
+        # error feedback keeps the compressed trajectory glued to the
+        # uncompressed one (quantization error is carried, not lost)
+        assert np.max(np.abs(np.array(comp) - np.array(base))) < 0.05, \
+            (comp, base)
+        assert telemetry.counter("kvstore.compressed_bytes").value > before
+        ratio = telemetry.gauge("kvstore.compression_ratio").value
+        assert ratio >= 8.0, ratio
+        # error-feedback residuals materialized as dcn-sharded opt-state
+        assert tr._dcn_residuals is not None
+        shapes = {n: tuple(v.shape) for n, v in tr._dcn_residuals.items()}
+        assert all(s[0] == 2 for s in shapes.values()), shapes
+    finally:
+        config.set("kvstore.grad_compress", "")
+        config.set("kvstore.grad_compression_threshold", 0.5)
+
+
+def test_compressed_checkpoint_roundtrip_is_bitwise(tmp_path):
+    config.set("kvstore.grad_compress", "2bit")
+    config.set("kvstore.grad_compression_threshold", 0.05)
+    try:
+        batches = _batches(6, seed=2)
+        tr = _dcn_trainer("ck_")
+        for x, y in batches[:3]:
+            tr.step(x, y)
+        path = str(tmp_path / "c.ckpt")
+        tr.save_checkpoint(path)
+        cont = [float(tr.step(x, y)) for x, y in batches[3:]]
+
+        tr2 = _dcn_trainer("ck_")
+        assert tr2.load_checkpoint(path) == 3
+        resumed = [float(tr2.step(x, y)) for x, y in batches[3:]]
+        # residuals rode the snapshot: the resumed run is the SAME run
+        assert resumed == cont
+    finally:
+        config.set("kvstore.grad_compress", "")
+        config.set("kvstore.grad_compression_threshold", 0.5)
+
+
+def test_compressed_nanguard_rolls_back_residuals():
+    config.set("kvstore.grad_compress", "2bit")
+    config.set("resilience.nanguard", "skip")
+    try:
+        batches = _batches(4, seed=3)
+        tr = _dcn_trainer("ng_")
+        tr.step(*batches[0])
+        params_before = {n: np.asarray(v) for n, v in tr.params.items()}
+        res_before = {n: np.asarray(v)
+                      for n, v in tr._dcn_residuals.items()}
+        config.set("resilience.faults", "nan:1")
+        bad = float(tr.step(*batches[1]))
+        config.set("resilience.faults", "")
+        assert not np.isfinite(bad)
+        # the guarded step dropped the update AND the residual commit —
+        # otherwise the quantization error of a rolled-back step would
+        # leak into the next one
+        for n, v in tr.params.items():
+            np.testing.assert_array_equal(np.asarray(v), params_before[n])
+        for n, v in tr._dcn_residuals.items():
+            np.testing.assert_array_equal(np.asarray(v), res_before[n])
+        good = float(tr.step(*batches[2]))
+        assert np.isfinite(good)
+    finally:
+        config.set("resilience.faults", "")
+        config.set("resilience.nanguard", "")
+        config.set("kvstore.grad_compress", "")
+
+
+# ------------------------------------------------------- elastic launcher
+def test_launch_elastic_restart_loop(tmp_path):
+    """Generation loop without jax: a worker that asks for preemption in
+    generation 0 (flag file + exit 0) must be relaunched exactly once and
+    the job must end rc=0 with a clean flag dir."""
+    spec = importlib.util.spec_from_file_location(
+        "launch", os.path.join(ROOT, "tools", "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+    runs = str(tmp_path / "runs.txt")
+    worker = (
+        "import os\n"
+        "d = os.environ['MXTPU_ELASTIC_DIR']\n"
+        "gen = os.environ['MXTPU_ELASTIC_GENERATION']\n"
+        "rank = os.environ['MXTPU_PROCESS_ID']\n"
+        "with open(%r, 'a') as f: f.write(gen + '-' + rank + chr(10))\n"
+        "if gen == '0':\n"
+        "    open(os.path.join(d, 'preempt-r' + rank), 'w').close()\n"
+        % runs)
+    rc = launch.launch_elastic(2, [sys.executable, "-c", worker],
+                               max_restarts=2,
+                               elastic_dir=str(tmp_path / "ed"))
+    assert rc == 0
+    with open(runs) as f:
+        lines = sorted(f.read().split())
+    assert lines == ["0-0", "0-1", "1-0", "1-1"], lines
+    left = os.listdir(str(tmp_path / "ed"))
+    assert not any(n.startswith("preempt-r") for n in left), left
+
+
+def test_launch_elastic_budget_exhaustion(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "launch", os.path.join(ROOT, "tools", "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+    worker = (
+        "import os\n"
+        "d = os.environ['MXTPU_ELASTIC_DIR']\n"
+        "rank = os.environ['MXTPU_PROCESS_ID']\n"
+        "open(os.path.join(d, 'preempt-r' + rank), 'w').close()\n")
+    rc = launch.launch_elastic(1, [sys.executable, "-c", worker],
+                               max_restarts=1,
+                               elastic_dir=str(tmp_path / "ed"))
+    assert rc != 0, "perpetually-preempted job must fail once budget spent"

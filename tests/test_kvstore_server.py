@@ -1,0 +1,47 @@
+"""Legacy-launcher compat contract of ``kvstore_server``.
+
+``_init_kvstore_server_module`` runs at ``import mxnet_tpu`` time: a
+process launched with the obsolete ps-lite roles (``DMLC_ROLE=server`` /
+``scheduler``) must exit 0 with the obsolete-role message instead of
+hanging waiting for pushes that never arrive.  Worker/unset roles must
+import normally.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(role):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    if role is None:
+        env.pop("DMLC_ROLE", None)
+    else:
+        env["DMLC_ROLE"] = role
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import mxnet_tpu; print('IMPORTED_OK')"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+
+
+@pytest.mark.parametrize("role", ["server", "scheduler"])
+def test_obsolete_role_exits_zero_with_message(role):
+    proc = _run(role)
+    assert proc.returncode == 0, proc.stderr
+    assert "obsolete" in proc.stderr
+    assert repr(role) in proc.stderr
+    # the process must have exited before finishing the import
+    assert "IMPORTED_OK" not in proc.stdout
+
+
+@pytest.mark.parametrize("role", [None, "worker"])
+def test_worker_role_imports_normally(role):
+    proc = _run(role)
+    assert proc.returncode == 0, proc.stderr
+    assert "IMPORTED_OK" in proc.stdout
+    assert "obsolete" not in proc.stderr

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Worker body for tools/check_dist_chaos.py — elastic dist-sync training.
+
+A deliberately tiny, fully deterministic distributed job: each rank owns a
+fixed shard of a linear-regression problem, gradients are summed across the
+world through the dist_sync kvstore (the DCN hop), and every step runs the
+``mx.elastic`` preemption agreement.  Determinism is the point — the chaos
+harness asserts the preempted-and-restarted run reproduces the
+uninterrupted baseline BITWISE, so every float here comes from seeded
+numpy + the deterministic host allreduce, never from wall clock or
+unordered reductions.
+
+Env contract (set by the harness / tools/launch.py):
+
+* ``MXTPU_CHAOS_STEPS``         total optimisation steps (default 10)
+* ``MXTPU_CHAOS_CKPT``          checkpoint dir -> CoordinatedCheckpointManager
+* ``MXTPU_CHAOS_OUT``           rank 0 writes the result JSON here
+* ``MXTPU_CHAOS_PREEMPT_RANK``  rank that self-injects ``peer_preempt``
+  (generation 0 only, at step ``MXTPU_CHAOS_PREEMPT_STEP``) — the other
+  rank learns of it purely through the cluster agreement.
+
+Not a pytest file: launched as N subprocesses with MXTPU_* rendezvous env.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+
+import numpy as np
+
+D = 8     # model dimension
+M = 16    # data rows per rank
+LR = 0.1
+
+
+def _make_data(rank):
+    """Per-rank shard of a shared linear-regression problem; the truth
+    vector is common so the global objective has one optimum."""
+    truth = np.random.RandomState(7).randn(D).astype(np.float32)
+    rng = np.random.RandomState(100 + rank)
+    a = rng.randn(M, D).astype(np.float32)
+    b = (a @ truth).astype(np.float32)
+    return a, b
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as cfg
+    from mxnet_tpu import elastic, parallel, resilience, telemetry
+
+    steps = int(os.environ.get("MXTPU_CHAOS_STEPS", "10"))
+
+    # Creating the dist kvstore bootstraps the rendezvous from launcher env.
+    kv = mx.kv.create("dist_sync")
+    import jax
+    rank, world = jax.process_index(), jax.process_count()
+
+    # The faulted rank draws a deterministic peer_preempt in generation 0
+    # ONLY — the restarted world must run to completion.  Composes with any
+    # fault spec the harness already exported via MXNET_TPU_FAULTS.
+    prank = os.environ.get("MXTPU_CHAOS_PREEMPT_RANK")
+    if prank is not None and int(prank) == rank and \
+            elastic.generation() == 0:
+        at = int(os.environ.get("MXTPU_CHAOS_PREEMPT_STEP", "5"))
+        cur = cfg.get("resilience.faults")
+        cfg.set("resilience.faults", (cur + "," if cur else "") +
+                "peer_preempt:1@step=%d" % at)
+
+    a, b = _make_data(rank)
+    state = {"step": 0, "w": np.zeros(D, np.float32), "losses": []}
+
+    def _save(path):
+        with resilience.atomic_write(path, "wb") as f:
+            pickle.dump({"step": state["step"], "w": state["w"],
+                         "losses": state["losses"]}, f)
+
+    def _load(path):
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        state["step"] = int(snap["step"])
+        state["w"] = np.asarray(snap["w"], np.float32)
+        state["losses"] = list(snap["losses"])
+
+    kv.init("g", mx.nd.zeros((D,)))
+    kv.barrier()
+
+    mgr, resumed = None, None
+    ckpt_dir = os.environ.get("MXTPU_CHAOS_CKPT")
+    if ckpt_dir:
+        mgr = elastic.CoordinatedCheckpointManager(
+            ckpt_dir, every_n_steps=2, keep=4)
+        resumed = mgr.restore(_load)
+
+    t0 = time.time()
+    for step in range(state["step"] + 1, steps + 1):
+        if elastic.maybe_cluster_preempt(step):
+            save_fn = None
+            if mgr is not None:
+                def save_fn():
+                    mgr.save(state["step"], _save)
+            resilience.exit_on_preempt(save_fn=save_fn)
+        r = a @ state["w"] - b
+        loss_local = np.float32(0.5) * np.float32(np.mean(r * r))
+        grad = (a.T @ r / np.float32(M)).astype(np.float32)
+        kv.push("g", mx.nd.array(grad))
+        out = mx.nd.zeros((D,))
+        kv.pull("g", out=out)
+        g = np.asarray(out.asnumpy(), np.float32) / np.float32(world)
+        gloss = float(np.asarray(parallel.host_allreduce(loss_local))
+                      / np.float32(world))
+        state["w"] = (state["w"] - np.float32(LR) * g).astype(np.float32)
+        state["losses"].append(gloss)
+        state["step"] = step
+        if mgr is not None:
+            mgr.maybe_save(step, _save)
+    elapsed = time.time() - t0
+
+    if rank == 0 and os.environ.get("MXTPU_CHAOS_OUT"):
+        snap = telemetry.snapshot()
+        c, gz = snap["counters"], snap["gauges"]
+        result = {
+            "world": world,
+            "steps": steps,
+            "generation": elastic.generation(),
+            "resumed_step": resumed,
+            # json round-trips double repr exactly -> the harness compares
+            # these for bitwise equality across legs
+            "losses": state["losses"],
+            "w": [float(x) for x in state["w"]],
+            "elapsed_s": elapsed,
+            "compressed_bytes": c.get("kvstore.compressed_bytes", 0),
+            "compressed_raw_bytes":
+                c.get("kvstore.compressed_raw_bytes", 0),
+            "compression_ratio": gz.get("kvstore.compression_ratio", 0.0),
+            "injected_dcn_push": c.get("resilience.injected.dcn_push", 0),
+            "retries": c.get("resilience.retries", 0),
+        }
+        with resilience.atomic_write(os.environ["MXTPU_CHAOS_OUT"],
+                                     "w") as f:
+            json.dump(result, f)
+    elastic.stop_heartbeat()
+    print("CHAOS_OK rank=%d/%d gen=%d steps=%d" %
+          (rank, world, elastic.generation(), state["step"]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

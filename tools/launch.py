@@ -81,6 +81,54 @@ def launch_local(num_workers, command, extra_env=None, poll_interval=0.2):
     return rc
 
 
+def launch_elastic(num_workers, command, max_restarts=1, elastic_dir=None,
+                   extra_env=None):
+    """Elastic generation loop: relaunch the world after a preemption.
+
+    Each generation runs ``launch_local`` with ``MXTPU_ELASTIC_DIR`` /
+    ``MXTPU_ELASTIC_GENERATION`` exported.  A generation that ends with
+    any ``preempt-r*`` flag in the elastic dir (ranks that agreed to
+    checkpoint-and-exit via mx.elastic) — or with a non-zero rc (a rank
+    hard-killed mid-step, or a heartbeat-lease abort, exit code 75) — is
+    restarted up to ``max_restarts`` times; workers auto-resume from the
+    newest valid coordinated snapshot through their CheckpointManager.
+    Returns the final generation's rc (0 = the job ran to completion).
+    """
+    import tempfile
+    if elastic_dir is None:
+        elastic_dir = tempfile.mkdtemp(prefix="mxtpu-elastic-")
+    os.makedirs(elastic_dir, exist_ok=True)
+    rc = 1
+    for gen in range(max_restarts + 1):
+        # flags from the previous generation answered their question
+        # (restart or not); a fresh world starts with a clean slate
+        for name in os.listdir(elastic_dir):
+            if name.startswith(("preempt-r", "hb-r")):
+                try:
+                    os.unlink(os.path.join(elastic_dir, name))
+                except OSError:
+                    pass
+        env = dict(extra_env or {})
+        env["MXTPU_ELASTIC_DIR"] = elastic_dir
+        env["MXTPU_ELASTIC_GENERATION"] = str(gen)
+        rc = launch_local(num_workers, command, extra_env=env)
+        preempted = any(n.startswith("preempt-r")
+                        for n in os.listdir(elastic_dir))
+        if rc == 0 and not preempted:
+            return 0
+        if gen >= max_restarts:
+            sys.stderr.write(
+                "launch.py: generation %d %s and the restart budget (%d) "
+                "is spent\n" % (gen, "was preempted" if preempted
+                                else "failed (rc=%d)" % rc, max_restarts))
+            return rc if rc != 0 else 1
+        sys.stderr.write(
+            "launch.py: generation %d %s; re-forming the world "
+            "(generation %d)\n" % (gen, "preempted" if preempted
+                                   else "failed (rc=%d)" % rc, gen + 1))
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True,
@@ -89,11 +137,25 @@ def main(argv=None):
                     help="only 'local' is implemented; on real multi-host "
                          "TPU use your cluster scheduler (GKE/SLURM) — jax "
                          "auto-detects those in parallel.initialize()")
+    ap.add_argument("--elastic", action="store_true",
+                    help="preemption-tolerant mode: restart the world "
+                         "after a coordinated preemption (mx.elastic) and "
+                         "resume from the newest valid snapshot")
+    ap.add_argument("--max-restarts", type=int, default=1,
+                    help="restart budget for --elastic (default 1)")
+    ap.add_argument("--elastic-dir", default=None,
+                    help="elastic state directory (default: a fresh "
+                         "temp dir); holds heartbeats, preempt flags and "
+                         "coordinated checkpoints")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="worker command line")
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("missing worker command")
+    if args.elastic:
+        sys.exit(launch_elastic(args.num_workers, args.command,
+                                max_restarts=args.max_restarts,
+                                elastic_dir=args.elastic_dir))
     sys.exit(launch_local(args.num_workers, args.command))
 
 
