@@ -775,6 +775,15 @@ register_knob(
     "free their slot mid-flight and queued prefills join without "
     "recompiling (batch is a symbolic dimension of the exported decode "
     "program). Raise for throughput, lower for per-token latency.")
+register_knob(
+    "serving.shared_prefix", "MXNET_TPU_SHARED_PREFIX", bool, True,
+    "share full prompt-prefix KV pages between concurrent generation "
+    "requests with a common prefix (the system-prompt case): pages are "
+    "content-hashed at submit, refcounted in the pool and freed when "
+    "the last reader exits. Causal attention makes the shared bytes "
+    "identical no matter which request wrote them, so token streams are "
+    "unchanged; serving.prefix_hits / serving.prefix_pages_shared count "
+    "the wins. Off = every request gets private pages.")
 
 
 def _positive_int_knob(name):

@@ -51,6 +51,22 @@ Format history (``meta["format_version"]``):
       ``load_generator(prefix)`` / ``serving.Server.register(...,
       generate=True)`` — and ``load_generator`` refuses non-v4 artifacts
       symmetrically.
+  v5  SAMPLING + int8-KV generation artifacts (``export_generation``
+      with ``sampling=True``, ``kv_quantized=True`` or a concrete
+      ``decode_batch``; plain calls keep writing v4): every program
+      takes per-row sampling controls — ``temperature`` [B] f32 (0 =
+      greedy, the default), ``top_k`` [B] i32 (0 = off), ``top_p`` [B]
+      f32 (1 = off) and a raw uint32 ``[B, 2]`` PRNG key folded with the
+      sampled position — and the KV pool rides as ONE pytree argument,
+      int8 payload + per-row f32 scale pools when ``kv_quantized``
+      (HALF the HBM per cached token; drift bounded by the
+      ``quant.error_budget`` knob, not the bitwise oracle).  A concrete
+      ``decode_batch`` pins the decode batch dim so the Pallas
+      paged-attention kernel (mx.kernels routing) can bake into the
+      decode programs — the routing verdict per width lands in
+      ``meta["paged"]`` at export, since an AOT artifact can never
+      re-route at serve time.  v4 artifacts keep loading through the
+      same ``load_generator`` with greedy-only semantics.
 """
 from __future__ import annotations
 
@@ -62,7 +78,8 @@ import numpy as _np
 
 __all__ = ["export_model", "load_model", "StableHLOPredictor",
            "export_generation", "load_generator", "GenerationPredictor",
-           "FORMAT_VERSION", "GENERATE_FORMAT_VERSION"]
+           "FORMAT_VERSION", "GENERATE_FORMAT_VERSION",
+           "SAMPLING_FORMAT_VERSION"]
 
 FORMAT_VERSION = 2
 
@@ -73,9 +90,13 @@ QUANTIZED_FORMAT_VERSION = 3
 #: program pair over a paged KV cache)
 GENERATE_FORMAT_VERSION = 4
 
+#: format version stamped by ``export_generation`` when sampling, int8 KV
+#: pages or a concrete decode batch are requested
+SAMPLING_FORMAT_VERSION = 5
+
 #: newest format this build can load; future versions error clearly
 #: instead of misinterpreting fields
-MAX_SUPPORTED_FORMAT = 4
+MAX_SUPPORTED_FORMAT = 5
 
 
 def _shape_signature(aval):
@@ -335,13 +356,19 @@ def _pow2_family(cap):
     return tuple(sizes)
 
 
+#: canonical pool-array order of a v5 KV pytree (quantized adds scales)
+_KV_KEYS = ("k", "v")
+_KV_KEYS_QUANT = ("k", "v", "k_scale", "v_scale")
+
+
 def export_generation(model, params, prefix, page_size=None,
                       max_context=None, prompt_buckets=None,
-                      include_params=True):
+                      include_params=True, sampling=False,
+                      kv_quantized=False, decode_batch=None):
     """Serialize a generation-capable model (``models.TransformerLM``) to
-    a v4 artifact: one PREFILL program per prompt-length bucket and one
-    single-token DECODE-step program per page-table width, both over a
-    block-paged KV cache whose pool size — and the batch dim — stay
+    a v4/v5 artifact: one PREFILL program per prompt-length bucket and
+    one single-token DECODE-step program per page-table width, both over
+    a block-paged KV cache whose pool size — and the batch dim — stay
     SYMBOLIC (jax.export shape polymorphism), so the serving side picks
     pool capacity and decode-slot count without re-exporting.
 
@@ -349,12 +376,24 @@ def export_generation(model, params, prefix, page_size=None,
     BAKED into the programs (page/slot arithmetic); ``max_context``
     (default ``model.cfg.max_len``) bounds prompt + generated tokens and
     sizes the width family; ``prompt_buckets`` defaults to the pow2
-    family over ``max_context`` with sub-8 buckets dropped.  Returns the
-    list of written paths."""
+    family over ``max_context`` with sub-8 buckets dropped.
+
+    Any of the three v5 features flips the format to v5 (the plain call
+    keeps writing v4 byte-identically): ``sampling`` threads per-row
+    temperature / top-k / top-p / PRNG-key controls through every
+    program (v5 programs ALWAYS carry them — greedy is per-row
+    ``temperature=0``, the default); ``kv_quantized`` makes the pool
+    int8 payload + per-row f32 scale pools (half the HBM per token);
+    ``decode_batch`` pins the decode programs' batch dim to a CONCRETE
+    size so trace-time kernel routing (``mx.kernels.paged_attention``)
+    can bake the Pallas paged kernel in — the per-width routing verdict
+    is recorded in ``meta["paged"]``.  Returns the list of written
+    paths."""
     import jax
     from jax import export as jexport
     import jax.numpy as jnp
     from . import config as _config
+    from . import kernels as _kernels
 
     cfg = model.cfg
     psz = int(page_size if page_size is not None
@@ -376,6 +415,13 @@ def export_generation(model, params, prefix, page_size=None,
             "prompt_buckets %r must be non-empty and fit max_context %d"
             % (prompt_buckets, max_context))
     widths = _pow2_family(_math.ceil(max_context / psz))
+    v5 = bool(sampling or kv_quantized or decode_batch is not None)
+    if decode_batch is not None:
+        decode_batch = int(decode_batch)
+        if decode_batch < 1:
+            raise ValueError("decode_batch must be >= 1, got %d"
+                             % decode_batch)
+    kv_keys = _KV_KEYS_QUANT if kv_quantized else _KV_KEYS
 
     flat = _flatten_params(params)
     names = [n for n, _ in flat]
@@ -383,14 +429,23 @@ def export_generation(model, params, prefix, page_size=None,
     param_tree = _unflatten_params(dict(zip(names, values)))
     pspec = jax.tree_util.tree_map(
         lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), param_tree)
-    spec = model.kv_spec()
+    spec = model.kv_spec(quantized=kv_quantized) if v5 else model.kv_spec()
     L, H, Dh = spec["num_layers"], spec["num_heads"], spec["head_dim"]
     kv_dtype = jnp.dtype(spec["dtype"])
 
     paths = []
+    paged_routes = {}
 
-    def _export_one(fn, arg_specs, path):
-        exp = jexport.export(jax.jit(fn))(*arg_specs)
+    def _export_one(fn, arg_specs, path, route_key=None):
+        with _kernels.record_paged_routes() as routes:
+            exp = jexport.export(jax.jit(fn))(*arg_specs)
+        if route_key is not None:
+            # one paged_attention route per scanned stack trace; the scan
+            # body compiles once, so one entry describes the whole program
+            paged_routes[route_key] = (
+                routes[0] if routes else {"impl": "xla",
+                                          "reason": "no paged site traced",
+                                          "quantized": bool(kv_quantized)})
         with open(path, "wb") as f:
             f.write(exp.serialize())
         paths.append(path)
@@ -403,55 +458,105 @@ def export_generation(model, params, prefix, page_size=None,
 
     def _kv_specs(p):
         shape = (L, p, psz, H, Dh)
+        if kv_quantized:
+            return (jax.ShapeDtypeStruct(shape, jnp.int8),
+                    jax.ShapeDtypeStruct(shape, jnp.int8),
+                    jax.ShapeDtypeStruct(shape[:-1], jnp.float32),
+                    jax.ShapeDtypeStruct(shape[:-1], jnp.float32))
         return (jax.ShapeDtypeStruct(shape, kv_dtype),
                 jax.ShapeDtypeStruct(shape, kv_dtype))
 
     i32 = jnp.int32
+
+    def _sample_specs(b):
+        return (jax.ShapeDtypeStruct((b,), jnp.float32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), jnp.float32),
+                jax.ShapeDtypeStruct((b, 2), jnp.uint32))
+
     for s_bucket in prompt_buckets:
         w_s = _math.ceil(s_bucket / psz)
-
-        def prefill_fn(ps, kk, vv, tokens, lengths, table):
-            kv, nxt = model.prefill(ps, {"k": kk, "v": vv}, tokens,
-                                    lengths, table, psz)
-            return kv["k"], kv["v"], nxt
-
         b, p = _dims()
-        kks, vvs = _kv_specs(p)
-        _export_one(
-            prefill_fn,
-            (pspec, kks, vvs,
-             jax.ShapeDtypeStruct((b, s_bucket), i32),
-             jax.ShapeDtypeStruct((b,), i32),
-             jax.ShapeDtypeStruct((b, w_s), i32)),
-            "%s-prefill-s%d.stablehlo" % (prefix, s_bucket))
+        if v5:
+            def prefill_fn(ps, kv, tokens, lengths, table,
+                           temp, top_k, top_p, keys):
+                sample = {"temperature": temp, "top_k": top_k,
+                          "top_p": top_p, "key": keys}
+                nkv, nxt = model.prefill(ps, dict(zip(kv_keys, kv)),
+                                         tokens, lengths, table, psz,
+                                         sample=sample)
+                return tuple(nkv[k] for k in kv_keys), nxt
+
+            specs = (pspec, _kv_specs(p),
+                     jax.ShapeDtypeStruct((b, s_bucket), i32),
+                     jax.ShapeDtypeStruct((b,), i32),
+                     jax.ShapeDtypeStruct((b, w_s), i32)) \
+                + _sample_specs(b)
+        else:
+            def prefill_fn(ps, kk, vv, tokens, lengths, table):
+                kv, nxt = model.prefill(ps, {"k": kk, "v": vv}, tokens,
+                                        lengths, table, psz)
+                return kv["k"], kv["v"], nxt
+
+            kks, vvs = _kv_specs(p)
+            specs = (pspec, kks, vvs,
+                     jax.ShapeDtypeStruct((b, s_bucket), i32),
+                     jax.ShapeDtypeStruct((b,), i32),
+                     jax.ShapeDtypeStruct((b, w_s), i32))
+        _export_one(prefill_fn, specs,
+                    "%s-prefill-s%d.stablehlo" % (prefix, s_bucket))
 
     for width in widths:
-        def decode_fn(ps, kk, vv, token_ids, positions, table):
-            kv, nxt = model.decode_step(ps, {"k": kk, "v": vv}, token_ids,
-                                        positions, table, psz)
-            return kv["k"], kv["v"], nxt
-
         b, p = _dims()
-        kks, vvs = _kv_specs(p)
-        _export_one(
-            decode_fn,
-            (pspec, kks, vvs,
-             jax.ShapeDtypeStruct((b,), i32),
-             jax.ShapeDtypeStruct((b,), i32),
-             jax.ShapeDtypeStruct((b, width), i32)),
-            "%s-decode-w%d.stablehlo" % (prefix, width))
+        bd = decode_batch if decode_batch is not None else b
+        if v5:
+            def decode_fn(ps, kv, token_ids, positions, table,
+                          temp, top_k, top_p, keys):
+                sample = {"temperature": temp, "top_k": top_k,
+                          "top_p": top_p, "key": keys}
+                nkv, nxt = model.decode_step(ps, dict(zip(kv_keys, kv)),
+                                             token_ids, positions, table,
+                                             psz, sample=sample)
+                return tuple(nkv[k] for k in kv_keys), nxt
+
+            specs = (pspec, _kv_specs(p),
+                     jax.ShapeDtypeStruct((bd,), i32),
+                     jax.ShapeDtypeStruct((bd,), i32),
+                     jax.ShapeDtypeStruct((bd, width), i32)) \
+                + _sample_specs(bd)
+        else:
+            def decode_fn(ps, kk, vv, token_ids, positions, table):
+                kv, nxt = model.decode_step(ps, {"k": kk, "v": vv},
+                                            token_ids, positions, table,
+                                            psz)
+                return kv["k"], kv["v"], nxt
+
+            kks, vvs = _kv_specs(p)
+            specs = (pspec, kks, vvs,
+                     jax.ShapeDtypeStruct((bd,), i32),
+                     jax.ShapeDtypeStruct((bd,), i32),
+                     jax.ShapeDtypeStruct((bd, width), i32))
+        _export_one(decode_fn, specs,
+                    "%s-decode-w%d.stablehlo" % (prefix, width),
+                    route_key=str(width))
 
     meta = {
         "param_names": names,
         "input_dtype": "int32",
-        "format_version": GENERATE_FORMAT_VERSION,
+        "format_version": (SAMPLING_FORMAT_VERSION if v5
+                           else GENERATE_FORMAT_VERSION),
         "generate": True,
         "vocab_size": int(cfg.vocab_size),
         "max_context": max_context,
         "prompt_buckets": list(prompt_buckets),
         "decode_widths": list(widths),
         "kv": dict(spec, page_size=psz),
+        "paged": paged_routes,
     }
+    if v5:
+        meta["sampling"] = True
+        if decode_batch is not None:
+            meta["decode_batch"] = decode_batch
     meta_path = prefix + "-meta.json"
     with open(meta_path, "w") as f:
         json.dump(meta, f)
@@ -498,6 +603,16 @@ class GenerationPredictor:
         self.prompt_buckets = tuple(self.meta["prompt_buckets"])
         self.decode_widths = tuple(self.meta["decode_widths"])
         self.kv_dtype = _np.dtype(self.meta["kv"]["dtype"])
+        #: v5 surface — v4 artifacts default to greedy-only fp pools
+        self.sampling = bool(self.meta.get("sampling", False))
+        self.kv_quantized = bool(self.meta["kv"].get("quantized", False))
+        db = self.meta.get("decode_batch")
+        self.decode_batch = int(db) if db is not None else None
+        #: per-width kernel routing verdict recorded at export (an AOT
+        #: program can never re-route at serve time)
+        self.paged_routes = dict(self.meta.get("paged", {}))
+        self._v5 = self.format_version >= SAMPLING_FORMAT_VERSION
+        self._kv_keys = _KV_KEYS_QUANT if self.kv_quantized else _KV_KEYS
         self._prefill_exp = {}
         self._decode_exp = {}
         for s_bucket in self.prompt_buckets:
@@ -543,15 +658,28 @@ class GenerationPredictor:
         return width
 
     def prefill_fn(self, s_bucket):
-        """Cached jit wrapper for one prefill bucket; the KV pool args
-        are DONATED so the appended-to cache aliases in place."""
+        """Cached jit wrapper for one prefill bucket, UNIFORM across
+        formats: ``fn(ps, kv_tuple, tokens, lengths, table, temp, top_k,
+        top_p, keys) -> (kv_tuple, next_ids)``.  The KV pool pytree is
+        DONATED so the appended-to cache aliases in place; v4 programs
+        ignore the sampling args (greedy is the only lowering they
+        carry)."""
         fn = self._prefill_call.get(s_bucket)
         if fn is None:
             exp = self._prefill_exp[s_bucket]
-            fn = self._jax.jit(
-                lambda ps, kk, vv, tokens, lengths, table:
-                exp.call(ps, kk, vv, tokens, lengths, table),
-                donate_argnums=(1, 2))
+            if self._v5:
+                fn = self._jax.jit(
+                    lambda ps, kv, tokens, lengths, table, temp, tk, tp,
+                    keys: exp.call(ps, kv, tokens, lengths, table,
+                                   temp, tk, tp, keys),
+                    donate_argnums=(1,))
+            else:
+                def fn_v4(ps, kv, tokens, lengths, table, temp, tk, tp,
+                          keys):
+                    kk, vv, nxt = exp.call(ps, kv[0], kv[1], tokens,
+                                           lengths, table)
+                    return (kk, vv), nxt
+                fn = self._jax.jit(fn_v4, donate_argnums=(1,))
             self._prefill_call[s_bucket] = fn
         return fn
 
@@ -559,34 +687,93 @@ class GenerationPredictor:
         fn = self._decode_call.get(width)
         if fn is None:
             exp = self._decode_exp[width]
-            fn = self._jax.jit(
-                lambda ps, kk, vv, token_ids, positions, table:
-                exp.call(ps, kk, vv, token_ids, positions, table),
-                donate_argnums=(1, 2))
+            if self._v5:
+                fn = self._jax.jit(
+                    lambda ps, kv, token_ids, positions, table, temp, tk,
+                    tp, keys: exp.call(ps, kv, token_ids, positions,
+                                       table, temp, tk, tp, keys),
+                    donate_argnums=(1,))
+            else:
+                def fn_v4(ps, kv, token_ids, positions, table, temp, tk,
+                          tp, keys):
+                    kk, vv, nxt = exp.call(ps, kv[0], kv[1], token_ids,
+                                           positions, table)
+                    return (kk, vv), nxt
+                fn = self._jax.jit(fn_v4, donate_argnums=(1,))
             self._decode_call[width] = fn
         return fn
 
     def make_kv(self, num_pages):
-        """Zeroed page pool sized for this artifact's KV spec."""
+        """Zeroed page pool tuple sized for this artifact's KV spec —
+        ``(k, v)`` or, for int8-KV artifacts, ``(k, v, k_scale,
+        v_scale)`` (int8 payloads + per-row f32 scales)."""
         import jax.numpy as jnp
         kv = self.meta["kv"]
         shape = (kv["num_layers"], int(num_pages), self.page_size,
                  kv["num_heads"], kv["head_dim"])
+        if self.kv_quantized:
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], jnp.float32),
+                    jnp.zeros(shape[:-1], jnp.float32))
         dt = jnp.dtype(kv["dtype"])
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
+    def kv_pool_specs(self, num_pages):
+        """ShapeDtypeStruct tuple matching :meth:`make_kv` — what the
+        serving engine AOT-traces its programs against."""
+        import jax
+        import jax.numpy as jnp
+        kv = self.meta["kv"]
+        shape = (kv["num_layers"], int(num_pages), self.page_size,
+                 kv["num_heads"], kv["head_dim"])
+        if self.kv_quantized:
+            return (jax.ShapeDtypeStruct(shape, jnp.int8),
+                    jax.ShapeDtypeStruct(shape, jnp.int8),
+                    jax.ShapeDtypeStruct(shape[:-1], jnp.float32),
+                    jax.ShapeDtypeStruct(shape[:-1], jnp.float32))
+        dt = jnp.dtype(kv["dtype"])
+        return (jax.ShapeDtypeStruct(shape, dt),
+                jax.ShapeDtypeStruct(shape, dt))
+
+    def sample_arrays(self, temperature, top_k, top_p, seeds):
+        """Host-side per-row sampling operand build: lists/arrays of
+        per-row controls -> the (temp f32, top_k i32, top_p f32,
+        keys uint32[B,2]) device operands every v5 program takes.  Seeds
+        are 64-bit ints split across the raw uint32 key words — the
+        layout ``jax.random.PRNGKey`` uses — so a request seed maps to
+        ONE deterministic stream."""
+        temp = _np.asarray(temperature, _np.float32).reshape(-1)
+        B = temp.shape[0]
+        keys = _np.zeros((B, 2), _np.uint32)
+        s = _np.asarray(seeds, _np.uint64).reshape(-1)
+        keys[:, 0] = (s >> _np.uint64(32)).astype(_np.uint32)
+        keys[:, 1] = (s & _np.uint64(0xFFFFFFFF)).astype(_np.uint32)
+        return (temp, _np.asarray(top_k, _np.int32).reshape(-1),
+                _np.asarray(top_p, _np.float32).reshape(-1), keys)
+
     # offline convenience --------------------------------------------
-    def generate(self, prompt, max_new_tokens, eos_id=None, params=None):
-        """Greedy-decode ONE sequence through the exported programs
-        (prefill into a private page pool, then single-token decode
-        steps).  Returns generated ids (eos included when hit) as
-        np.int32 — the exact stream the serving scheduler produces for
-        the same request, minus the batching."""
+    def generate(self, prompt, max_new_tokens, eos_id=None, params=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
+        """Decode ONE sequence through the exported programs (prefill
+        into a private page pool, then single-token decode steps).
+        Default is greedy; ``temperature``/``top_k``/``top_p``/``seed``
+        engage v5 sampling (a ValueError on v4 artifacts, which only
+        carry the greedy lowering).  Returns generated ids (eos included
+        when hit) as np.int32 — the exact stream the serving scheduler
+        produces for the same request, minus the batching."""
         import jax.numpy as jnp
         ps = params if params is not None else self._params
         if ps is None:
             raise ValueError("no params: artifact exported with "
                              "include_params=False and none were given")
+        temperature = float(temperature)
+        if temperature > 0 and not self.sampling:
+            raise ValueError(
+                "temperature=%g needs a sampling (format v5) artifact; "
+                "this one is format v%d (greedy only) — re-export with "
+                "export_generation(..., sampling=True)"
+                % (temperature, self.format_version))
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
         plen = int(prompt.shape[0])
         max_new = int(max_new_tokens)
@@ -599,7 +786,7 @@ class GenerationPredictor:
                 "%d" % (plen, max_new, self.max_context))
         psz = self.page_size
         need = _math.ceil((plen + max_new) / psz)
-        kk, vv = self.make_kv(need)
+        kv = self.make_kv(need)
         pages = _np.arange(need, dtype=_np.int32)
         sentinel = need
         s_bucket = self.prefill_bucket(plen)
@@ -608,26 +795,40 @@ class GenerationPredictor:
         tokens[0, :plen] = prompt
         table = _np.full((1, w_s), sentinel, _np.int32)
         table[0, :min(w_s, need)] = pages[:w_s]
-        kk, vv, nxt = self.prefill_fn(s_bucket)(
-            ps, kk, vv, jnp.asarray(tokens),
-            jnp.asarray([plen], jnp.int32), jnp.asarray(table))
+        samp1 = self.sample_arrays([temperature], [top_k], [top_p],
+                                   [int(seed)])
+        kv, nxt = self.prefill_fn(s_bucket)(
+            ps, kv, jnp.asarray(tokens),
+            jnp.asarray([plen], jnp.int32), jnp.asarray(table), *samp1)
         out = [int(nxt[0])]
         pos = plen
+        # a concrete decode_batch pins the decode batch dim: row 0 is
+        # the live sequence, the pad rows run against an all-sentinel
+        # table (their writes drop, their outputs are ignored)
+        Bd = self.decode_batch or 1
+        sampB = self.sample_arrays(
+            [temperature] + [0.0] * (Bd - 1), [int(top_k)] + [0] * (Bd - 1),
+            [float(top_p)] + [1.0] * (Bd - 1), [int(seed)] + [0] * (Bd - 1))
         while len(out) < max_new and (eos_id is None
                                       or out[-1] != int(eos_id)):
             width = self.decode_width(pos // psz + 1)
-            table = _np.full((1, width), sentinel, _np.int32)
+            table = _np.full((Bd, width), sentinel, _np.int32)
             table[0, :min(width, need)] = pages[:width]
-            kk, vv, nxt = self.decode_fn(width)(
-                ps, kk, vv, jnp.asarray([out[-1]], jnp.int32),
-                jnp.asarray([pos], jnp.int32), jnp.asarray(table))
+            toks = _np.zeros((Bd,), _np.int32)
+            toks[0] = out[-1]
+            poss = _np.zeros((Bd,), _np.int32)
+            poss[0] = pos
+            kv, nxt = self.decode_fn(width)(
+                ps, kv, jnp.asarray(toks), jnp.asarray(poss),
+                jnp.asarray(table), *sampB)
             out.append(int(nxt[0]))
             pos += 1
         return _np.asarray(out, _np.int32)
 
 
 def load_generator(prefix):
-    """Reload a v4 generation artifact (prefill + decode-step program
-    families over a paged KV cache).  Refuses one-shot v1–v3 artifacts —
-    those load with :func:`load_model`."""
+    """Reload a v4/v5 generation artifact (prefill + decode-step program
+    families over a paged KV cache; v5 adds sampling controls, int8 KV
+    pages and/or a pinned decode batch).  Refuses one-shot v1–v3
+    artifacts — those load with :func:`load_model`."""
     return GenerationPredictor(prefix)

@@ -53,6 +53,21 @@ Key properties:
   it, so the engine fails every in-flight sequence with the causal
   error, rebuilds the pool zeroed, feeds the model's circuit breaker and
   keeps serving.
+* **Shared-prefix pages** (``serving.shared_prefix``) — full prompt-
+  prefix pages are content-hashed at admission; concurrent requests with
+  a common prefix (the system-prompt case) map to the SAME physical
+  pages with refcounted sharing, freed only when the last reader exits.
+  Causal attention makes a prefix position's K/V depend only on the
+  tokens before it, so the shared bytes are identical no matter which
+  sharer wrote them; divergence is page-granular copy-on-write by
+  construction — the first token past the shared full pages lands in a
+  private page.  ``serving.prefix_hits`` / ``serving.prefix_pages_shared``
+  count the wins; ``kv_pages_in_use`` counts every physical page ONCE.
+* **Sampling** (v5 artifacts) — per-request temperature / top-k / top-p
+  ride the decode program family with a per-request PRNG key folded by
+  position, so a fixed seed yields ONE deterministic stream regardless
+  of batch composition.  Greedy (temperature 0) stays the default and
+  keeps the bitwise oracle contract.
 * **PR-7 fault tolerance per slot** — admission sheds past
   ``serving.max_pending`` (ServerOverloadedError), queued requests whose
   deadline lapses complete typed and never prefill
@@ -100,6 +115,11 @@ __all__ = ["GenerationEngine"]
 _LOG = logging.getLogger("mxnet_tpu.generation")
 
 
+def _kernels_enabled():
+    from . import kernels as _kernels
+    return _kernels.enabled()
+
+
 class _EngineCrashError(OSError):
     """Internal: wraps an engine-loop crash so
     ``resilience.call_with_retry`` drives the restart backoff."""
@@ -111,10 +131,12 @@ class _GenRequest:
 
     __slots__ = ("prompt", "plen", "max_new", "eos_id", "future",
                  "t_submit", "deadline", "need", "stall_counted",
-                 "trace_id")
+                 "trace_id", "temperature", "top_k", "top_p", "key_words",
+                 "prefix_keys")
 
     def __init__(self, prompt, max_new, eos_id, deadline_ms, need,
-                 trace_id=None):
+                 trace_id=None, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=0, prefix_keys=()):
         self.prompt = prompt
         self.plen = int(prompt.shape[0])
         self.max_new = int(max_new)
@@ -126,6 +148,17 @@ class _GenRequest:
         self.need = int(need)          # pages for prompt + max_new
         self.stall_counted = False     # kv_pool_exhausted counted once
         self.trace_id = trace_id       # submit span id for the access log
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        # raw uint32 key words in jax.random.PRNGKey layout — built
+        # host-side once so every dispatch sees the same stream identity
+        s = int(seed) & 0xFFFFFFFFFFFFFFFF
+        self.key_words = (s >> 32, s & 0xFFFFFFFF)
+        # content hashes of the FULL prompt-prefix pages, page 0 first:
+        # key i covers tokens [0, (i+1)*page_size) — admission maps them
+        # to shared physical pages
+        self.prefix_keys = tuple(prefix_keys)
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -133,16 +166,20 @@ class _GenRequest:
 
 class _Slot:
     """One active decode slot: the sequence's pages, cached length and
-    generated tokens.  Engine-thread-only state."""
+    generated tokens.  Engine-thread-only state (``prefix_keys`` names
+    the leading ``slot.pages`` entries owned by the shared-prefix map —
+    released through ``_release_pages_locked``, never freed directly)."""
 
-    __slots__ = ("req", "pages", "pos", "tokens", "ttft_ms")
+    __slots__ = ("req", "pages", "pos", "tokens", "ttft_ms",
+                 "prefix_keys")
 
-    def __init__(self, req, pages):
+    def __init__(self, req, pages, prefix_keys=()):
         self.req = req
         self.pages = pages
         self.pos = req.plen      # tokens already in the cache
         self.tokens = []
         self.ttft_ms = None
+        self.prefix_keys = tuple(prefix_keys)
 
 
 class GenerationEngine:
@@ -163,6 +200,11 @@ class GenerationEngine:
                              else _config.get("serving.kv_pages"))
         self.decode_slots = int(decode_slots if decode_slots is not None
                                 else _config.get("serving.decode_slots"))
+        if predictor.decode_batch is not None:
+            # the artifact pinned its decode batch at export (a concrete
+            # dim is what lets the Pallas paged kernel bake in) — the
+            # AOT program admits exactly that many slots, knob or not
+            self.decode_slots = predictor.decode_batch
         self.max_pending = int(max_pending if max_pending is not None
                                else _config.get("serving.max_pending"))
         self.default_deadline_ms = float(
@@ -176,10 +218,13 @@ class GenerationEngine:
             raise ServingError(
                 "model %r: serving.kv_pages=%d cannot hold one page"
                 % (name, self.num_pages))
+        self._share = bool(_config.get("serving.shared_prefix"))
         # Cross-thread state (submit side vs engine thread) — the same
         # lock-discipline contract tools/mxlint.py checks on the Server.
         self._queue = deque()            # guarded-by: _cond
         self._free = list(range(self.num_pages))  # guarded-by: _cond
+        # shared-prefix map: content key -> [page_id, refcount, populated]
+        self._prefix = {}                # guarded-by: _cond
         self._cond = threading.Condition()
         self._started = False            # guarded-by: _cond
         self._stopping = False           # guarded-by: _cond
@@ -193,8 +238,7 @@ class GenerationEngine:
         # Engine-thread-only state: the page pool arrays and decode slots
         # are touched exclusively by the engine loop — no lock.
         self._slots = [None] * self.decode_slots
-        self._kk = None
-        self._vv = None
+        self._kv = None       # page-pool pytree (2 arrays, 4 when int8)
         self._prefill = {}    # prompt bucket -> compiled program
         self._decode = {}     # page-table width -> compiled program
 
@@ -211,11 +255,16 @@ class GenerationEngine:
         params = gp._params
         pspec = jax.tree_util.tree_map(
             lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
-        kv = gp.meta["kv"]
-        pool_shape = (kv["num_layers"], self.num_pages, gp.page_size,
-                      kv["num_heads"], kv["head_dim"])
-        kspec = jax.ShapeDtypeStruct(pool_shape, gp.kv_dtype)
+        kvspec = gp.kv_pool_specs(self.num_pages)
         i32 = _np.int32
+
+        def sample_specs(b):
+            # the uniform program wrappers take the sampling operands in
+            # every format (v4 ignores them)
+            return (jax.ShapeDtypeStruct((b,), _np.float32),
+                    jax.ShapeDtypeStruct((b,), i32),
+                    jax.ShapeDtypeStruct((b,), _np.float32),
+                    jax.ShapeDtypeStruct((b, 2), _np.uint32))
 
         def compile_one(fn, arg_specs, label):
             t0 = _time.perf_counter()
@@ -244,20 +293,22 @@ class GenerationEngine:
             w_s = _math.ceil(s_bucket / gp.page_size)
             self._prefill[s_bucket] = compile_one(
                 gp.prefill_fn(s_bucket),
-                (pspec, kspec, kspec,
+                (pspec, kvspec,
                  jax.ShapeDtypeStruct((1, s_bucket), i32),
                  jax.ShapeDtypeStruct((1,), i32),
-                 jax.ShapeDtypeStruct((1, w_s), i32)),
+                 jax.ShapeDtypeStruct((1, w_s), i32))
+                + sample_specs(1),
                 "prefill-s%d" % s_bucket)
         for width in gp.decode_widths:
             if width in self._decode:
                 continue
             self._decode[width] = compile_one(
                 gp.decode_fn(width),
-                (pspec, kspec, kspec,
+                (pspec, kvspec,
                  jax.ShapeDtypeStruct((self.decode_slots,), i32),
                  jax.ShapeDtypeStruct((self.decode_slots,), i32),
-                 jax.ShapeDtypeStruct((self.decode_slots, width), i32)),
+                 jax.ShapeDtypeStruct((self.decode_slots, width), i32))
+                + sample_specs(self.decode_slots),
                 "decode-w%d" % width)
 
     # --------------------------------------------------------- lifecycle
@@ -267,7 +318,7 @@ class GenerationEngine:
             if self._started:
                 return self
         self._compile_programs()
-        self._kk, self._vv = self.predictor.make_kv(self.num_pages)
+        self._kv = self.predictor.make_kv(self.num_pages)
         with self._cond:
             self._stopping = False
             self._abort = False
@@ -310,10 +361,14 @@ class GenerationEngine:
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline_ms=None):
+               deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
+               seed=None):
         """Enqueue one prompt; returns a Future resolving to the
-        generated token ids (np.int32, EOS included when hit) — the
-        bitwise ``greedy_decode`` stream."""
+        generated token ids (np.int32, EOS included when hit).  With
+        ``temperature`` 0 (default) that is the bitwise
+        ``greedy_decode`` stream; ``temperature`` > 0 samples with
+        optional ``top_k`` / ``top_p`` truncation under a per-request
+        ``seed`` (fresh entropy when None) — v5 artifacts only."""
         gp = self.predictor
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
         plen = int(prompt.shape[0])
@@ -322,6 +377,15 @@ class GenerationEngine:
             raise ValueError(
                 "model %r: need a non-empty prompt and max_new_tokens "
                 ">= 1" % (self.name,))
+        temperature = float(temperature)
+        if temperature > 0.0 and not gp.sampling:
+            raise ValueError(
+                "model %r: temperature=%g needs a sampling-enabled "
+                "artifact (format v5) — re-export with "
+                "export_generation(..., sampling=True)"
+                % (self.name, temperature))
+        if seed is None:
+            seed = _time.time_ns() if temperature > 0.0 else 0
         if plen + max_new > gp.max_context:
             raise ValueError(
                 "model %r: prompt (%d) + max_new_tokens (%d) exceeds the "
@@ -351,9 +415,21 @@ class GenerationEngine:
                    breaker.cooldown_remaining_ms()))
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        prefix_keys = ()
+        if self._share:
+            # content keys for the FULL prompt-prefix pages: key i covers
+            # tokens [0, (i+1)*page_size) — causal attention makes the
+            # page's K/V a pure function of those tokens, so equal keys
+            # mean byte-equal pages
+            psz = gp.page_size
+            prefix_keys = tuple(
+                (i, prompt[:(i + 1) * psz].tobytes())
+                for i in range(plen // psz))
         req = _GenRequest(prompt, max_new, eos_id,
                           float(deadline_ms or 0.0), need,
-                          trace_id=trace_id)
+                          trace_id=trace_id, temperature=temperature,
+                          top_k=top_k, top_p=top_p, seed=seed,
+                          prefix_keys=prefix_keys)
         with self._cond:
             if self._dead is not None:
                 exc = self._dead
@@ -441,7 +517,7 @@ class GenerationEngine:
         """Fail every in-flight sequence and recycle its pages (the pool
         arrays were donated into the failed dispatch, so their state is
         gone — rebuild zeroed)."""
-        freed = []
+        released = []
         outcome = _access_outcome(exc)
         err = ("%s: %s" % (type(exc).__name__, exc)
                if outcome == "error" else None)
@@ -449,7 +525,7 @@ class GenerationEngine:
             if slot is None:
                 continue
             self._slots[i] = None
-            freed.extend(slot.pages)
+            released.append(slot)
             if not slot.req.future.done():
                 slot.req.future.set_exception(exc)
                 if _obs.access_log_enabled():
@@ -458,12 +534,34 @@ class GenerationEngine:
                         request_id=slot.req.trace_id,
                         ttft_ms=slot.ttft_ms,
                         tokens=len(slot.tokens), error=err)
-        if freed:
-            with self._cond:
-                self._free.extend(freed)
-                self._cond.notify_all()
+        with self._cond:
+            for slot in released:
+                self._release_pages_locked(slot)
+            # the rebuilt pool is zeroed, so any surviving shared-prefix
+            # entries (refs held only by already-failed slots) are stale
+            # — drop them and recycle their pages
+            for entry in self._prefix.values():
+                self._free.append(entry[0])
+            self._prefix.clear()
+            self._cond.notify_all()
         self._gauge_pages()
-        self._kk, self._vv = self.predictor.make_kv(self.num_pages)
+        self._kv = self.predictor.make_kv(self.num_pages)
+
+    def _release_pages_locked(self, slot):  # mxlint: holds(_cond)
+        """Return a slot's pages to the free list — shared-prefix pages
+        decref through the map and only hit the free list when the LAST
+        reader exits; the trailing private pages free unconditionally.
+        ``kv_pages_in_use`` therefore counts every physical page once."""
+        for key in slot.prefix_keys:
+            entry = self._prefix.get(key)
+            if entry is None:      # pool rebuild cleared the map already
+                continue
+            entry[1] -= 1
+            if entry[1] <= 0:
+                del self._prefix[key]
+                self._free.append(entry[0])
+        self._free.extend(slot.pages[len(slot.prefix_keys):])
+        self._cond.notify_all()
 
     def _gauge_pages(self):
         with self._cond:
@@ -486,7 +584,18 @@ class GenerationEngine:
         free_slots = [i for i, s in enumerate(self._slots) if s is None]
         while self._queue and free_slots:
             req = self._queue[0]
-            if req.need > len(self._free):
+            # walk the request's full-prefix pages front-to-back: each
+            # key already in the map is a shared page this request can
+            # reuse instead of drawing from the free list.  The walk is
+            # contiguous — a sharer holding key i also holds 0..i-1, so
+            # refcounts are monotone non-increasing along the prefix.
+            shared = []
+            for key in req.prefix_keys:
+                entry = self._prefix.get(key)
+                if entry is None:
+                    break
+                shared.append((key, entry))
+            if req.need - len(shared) > len(self._free):
                 if not req.stall_counted:
                     req.stall_counted = True
                     _telemetry.counter("serving.kv_pool_exhausted").inc()
@@ -494,8 +603,26 @@ class GenerationEngine:
                         "serving.kv_pool_exhausted.%s" % self.name).inc()
                 break
             self._queue.popleft()
-            pages = [self._free.pop() for _ in range(req.need)]
-            self._slots[free_slots.pop(0)] = _Slot(req, pages)
+            pages = []
+            for key, entry in shared:
+                entry[1] += 1
+                pages.append(entry[0])
+            # the remaining FULL-prefix pages are fresh: register them so
+            # later requests with the same prompt prefix share them
+            for key in req.prefix_keys[len(shared):]:
+                page = self._free.pop()
+                self._prefix[key] = [page, 1, False]
+                pages.append(page)
+            while len(pages) < req.need:
+                pages.append(self._free.pop())
+            if shared:
+                _telemetry.counter("serving.prefix_hits").inc()
+                _telemetry.counter(
+                    "serving.prefix_hits.%s" % self.name).inc()
+                _telemetry.counter(
+                    "serving.prefix_pages_shared").inc(len(shared))
+            self._slots[free_slots.pop(0)] = _Slot(
+                req, pages, prefix_keys=req.prefix_keys)
             admitted.append(req)
         return admitted
 
@@ -586,8 +713,7 @@ class GenerationEngine:
         if breaker is not None and not breaker.allow_dispatch():
             self._slots[slot_idx] = None
             with self._cond:
-                self._free.extend(slot.pages)
-                self._cond.notify_all()
+                self._release_pages_locked(slot)
             if not req.future.done():
                 req.future.set_exception(CircuitOpenError(
                     "model %r circuit breaker is OPEN; prefill failed "
@@ -604,14 +730,40 @@ class GenerationEngine:
         table = _np.full((1, w_s), sentinel, _np.int32)
         k = min(w_s, len(slot.pages))
         table[0, :k] = slot.pages[:k]
+        # shared-prefix pages another request already POPULATED must not
+        # be rewritten mid-decode — sentinel them so this prefill's
+        # scatter drops those rows (the bytes are already there; the
+        # attention gather still reads them through slot.pages).
+        # Populated-ness is decided here at dispatch time, not admission:
+        # if the registering request died before its prefill ran, the
+        # next sharer writes the pages itself.
+        write_table = table
+        if slot.prefix_keys:
+            with self._cond:
+                populated = [bool(self._prefix[key][2])
+                             for key in slot.prefix_keys
+                             if key in self._prefix]
+            if any(populated):
+                write_table = table.copy()
+                for i, done in enumerate(populated):
+                    if done and i < w_s:
+                        write_table[0, i] = sentinel
+        temp, tk, tp, keys = self._sample_arrays([(0, slot)], 1)
         t0 = _time.perf_counter()
         try:
-            self._kk, self._vv, nxt = self._prefill[s_bucket](
-                gp._params, self._kk, self._vv, tokens,
-                _np.asarray([req.plen], _np.int32), table)
+            self._kv, nxt = self._prefill[s_bucket](
+                gp._params, self._kv, tokens,
+                _np.asarray([req.plen], _np.int32), write_table,
+                temp, tk, tp, keys)
             first = int(nxt[0])
         except BaseException as exc:  # noqa: BLE001 — pool donated away
             return self._dispatch_failed(exc)
+        if slot.prefix_keys:
+            with self._cond:
+                for key in slot.prefix_keys:
+                    entry = self._prefix.get(key)
+                    if entry is not None:
+                        entry[2] = True
         t1 = _time.perf_counter()
         if breaker is not None:
             breaker.record_success()
@@ -640,17 +792,15 @@ class GenerationEngine:
                 "failed fast, retry after the cooldown" % (self.name,))
             for i, _ in active:
                 self._slots[i] = None
-            freed = []
             for _, s in active:
-                freed.extend(s.pages)
                 if not s.req.future.done():
                     s.req.future.set_exception(exc)
                     _obs.log_access(
                         self.name, "breaker", request_id=s.req.trace_id,
                         ttft_ms=s.ttft_ms, tokens=len(s.tokens))
             with self._cond:
-                self._free.extend(freed)
-                self._cond.notify_all()
+                for _, s in active:
+                    self._release_pages_locked(s)
             self._gauge_pages()
             return
         width = _io.pick_bucket(
@@ -664,11 +814,12 @@ class GenerationEngine:
             positions[i] = s.pos
             k = min(width, len(s.pages))
             table[i, :k] = s.pages[:k]
+        temp, tk, tp, keys = self._sample_arrays(active, B)
         t0 = _time.perf_counter()
         try:
-            self._kk, self._vv, nxt = self._decode[width](
-                gp._params, self._kk, self._vv, token_ids, positions,
-                table)
+            self._kv, nxt = self._decode[width](
+                gp._params, self._kv, token_ids, positions, table,
+                temp, tk, tp, keys)
             nxt = _np.asarray(nxt)
         except BaseException as exc:  # noqa: BLE001 — pool donated away
             self._dispatch_failed(exc)
@@ -678,11 +829,37 @@ class GenerationEngine:
             breaker.record_success()
         _telemetry.timer("serving.decode_step_ms").observe(
             (t1 - t0) * 1e3)
+        route = gp.paged_routes.get(str(width))
+        if route is not None:
+            # serve-side mirror of the export-time routing verdict: every
+            # decode iteration that ran through the Pallas paged kernel
+            # (or fell back while the kernel tier was on) is counted
+            if route.get("impl") == "paged":
+                _telemetry.counter("kernels.paged_attention").inc()
+            elif _kernels_enabled():
+                _telemetry.counter("kernels.paged_fallback").inc()
         self._count_tokens(len(active))
         for i, s in active:
             s.tokens.append(int(nxt[i]))
             s.pos += 1
             self._maybe_finish(i)
+
+    def _sample_arrays(self, active, B):
+        """Per-row sampling operands for a dispatch: active rows carry
+        their request's temperature / top-k / top-p / PRNG key words;
+        padding rows ride greedy with a zero key (their output is
+        discarded, but every operand must still be well-formed)."""
+        temp = _np.zeros((B,), _np.float32)
+        tk = _np.zeros((B,), _np.int32)
+        tp = _np.ones((B,), _np.float32)
+        keys = _np.zeros((B, 2), _np.uint32)
+        for i, s in active:
+            req = s.req
+            temp[i] = req.temperature
+            tk[i] = req.top_k
+            tp[i] = req.top_p
+            keys[i] = req.key_words
+        return temp, tk, tp, keys
 
     def _count_tokens(self, n):
         _telemetry.counter("serving.tokens_generated").inc(n)
@@ -701,8 +878,7 @@ class GenerationEngine:
             return
         self._slots[slot_idx] = None
         with self._cond:
-            self._free.extend(slot.pages)
-            self._cond.notify_all()
+            self._release_pages_locked(slot)
         self._gauge_pages()
         t1 = _time.perf_counter()
         wall_ms = (t1 - req.t_submit) * 1e3
@@ -768,10 +944,19 @@ class GenerationEngine:
             queued = len(self._queue)
             free = len(self._free)
             thread = self._thread
+            prefix_entries = len(self._prefix)
+            prefix_shared = sum(
+                max(0, e[1] - 1) for e in self._prefix.values())
+        _telemetry.gauge(
+            "serving.prefix_shared_pages.%s" % self.name).set(
+            prefix_entries)
         return {
             "queued": queued,
             "active": len(self._active()),
             "decode_slots": self.decode_slots,
+            "shared_prefix": self._share,
+            "prefix_entries": prefix_entries,
+            "prefix_pages_shared": prefix_shared,
             "kv_pages": self.num_pages,
             "kv_pages_free": free,
             "page_size": self.predictor.page_size,

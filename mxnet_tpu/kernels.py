@@ -36,15 +36,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import contextlib
+
 from . import config as _config
 from . import telemetry as _telemetry
 from .ops.pallas_kernels import (flash_attention, fused_adam_step,
-                                 fused_sgd_step)
+                                 fused_sgd_step, pallas_paged_attention)
 
 __all__ = ["enabled", "attention", "paged_attention",
-           "flash_unsupported_reason", "fused_step_enabled",
-           "flash_attention", "fused_sgd_step", "fused_adam_step",
-           "measure"]
+           "flash_unsupported_reason", "paged_unsupported_reason",
+           "record_paged_routes", "fused_step_enabled",
+           "flash_attention", "pallas_paged_attention",
+           "fused_sgd_step", "fused_adam_step", "measure"]
 
 # one-row VMEM feasibility: a q block keeps its head's full K and V
 # resident, so 2 * Skv * D * itemsize must fit the budget
@@ -148,29 +151,94 @@ def attention(q, k, v, causal=False, scale=None):
     return _xla_attention(q, k, v, causal=causal, scale=scale)
 
 
-def paged_attention(q, k, v, valid, scale=None):
-    """Decode-step attention over a page-gathered context window.
+def paged_unsupported_reason(q, k, v, valid, quantized=False):
+    """Why the Pallas paged-attention kernel can NOT take this decode
+    call, or None if it can.  Trace-time shape/dtype checks only —
+    everything here must be static under jit.  A non-None reason routes
+    to the XLA lowering (``kernels.paged_fallback``) and is surfaced on
+    the ``kernels.paged`` tracing span so perf_report can attribute
+    decode time to kernel-vs-XLA."""
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        return "rank != 4 (got q%s k%s v%s)" % (q.ndim, k.ndim, v.ndim)
+    # jax.export shape polymorphism: a symbolic batch/pool dim can't
+    # answer the block/budget arithmetic below — decode programs that
+    # want the kernel export with a concrete decode_batch (deploy v5)
+    if not all(isinstance(d, int)
+               for d in tuple(q.shape) + tuple(k.shape) + tuple(v.shape)
+               + tuple(valid.shape)):
+        return "symbolic shape (q%s kv%s)" % (q.shape, k.shape)
+    if q.shape[2] != 1:
+        return "needs one query row per sequence, got Sq=%d" % q.shape[2]
+    if k.shape != v.shape:
+        return "k/v shapes differ: %s vs %s" % (k.shape, v.shape)
+    if q.shape[:2] != k.shape[:2]:
+        return "q/kv batch-head mismatch: %s vs %s" % (
+            q.shape[:2], k.shape[:2])
+    if q.shape[3] != k.shape[3]:
+        return "q/kv head dim mismatch: %d vs %d" % (
+            q.shape[3], k.shape[3])
+    if valid.shape != (q.shape[0], k.shape[2]):
+        return "valid mask shape %s != (B, K)=%s" % (
+            tuple(valid.shape), (q.shape[0], k.shape[2]))
+    if q.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return "unsupported dtype %s" % q.dtype
+    if quantized:
+        if k.dtype != jnp.int8:
+            return "quantized pages must be int8, got %s" % k.dtype
+    elif k.dtype != q.dtype:
+        return "q/kv dtype mismatch: %s vs %s" % (q.dtype, k.dtype)
+    if q.shape[3] > _MAX_HEAD_DIM:
+        return "head dim %d > %d" % (q.shape[3], _MAX_HEAD_DIM)
+    # one (batch, head) row keeps its full gathered K and V resident
+    kv_bytes = 2 * k.shape[2] * k.shape[3] * k.dtype.itemsize
+    budget = _config.get("kernels.vmem_budget")
+    if kv_bytes > budget:
+        return "kv slice %d bytes > vmem budget %d" % (kv_bytes, budget)
+    return None
 
-    ``q`` is the single new query ``[B, H, 1, Dh]``; ``k``/``v`` are the
-    context gathered through a request's page table ``[B, H, K, Dh]``
-    (``K = page_table_width * page_size``, so slots past the sequence's
-    true length hold stale or clipped-sentinel data); ``valid`` ``[B, K]``
-    masks exactly the real positions.  The math mirrors the XLA
-    attention lowering (``parallel.ring_attention._block_attn``): masked
-    scores pin to the same ``-1e30`` floor, so masked keys contribute an
-    EXACT ``0.0`` to both the softmax denominator and the value sum and
-    the result tracks an unpadded forward bitwise-closely enough for
-    greedy token parity (tools/check_generation.py enforces it).
 
-    Routing: this is the seam where a Pallas paged-attention kernel will
-    plug in; today every call takes the XLA lowering and, with the
-    kernel tier on, counts ``kernels.paged_fallback`` so the routing
-    table stays observable."""
-    if enabled():
-        _telemetry.counter("kernels.paged_fallback").inc()
+# Export-time route capture: deploy.export_generation traces the decode
+# program family under record_paged_routes() and lands the impl/reason of
+# every routed paged site in the artifact meta — the serve path then
+# counts kernels.paged_attention / paged_fallback per dispatch without
+# re-tracing (the program is AOT; trace-time counters fire at export).
+_PAGED_ROUTE_SINK = []
+
+
+@contextlib.contextmanager
+def record_paged_routes():
+    """Collect ``{"impl", "reason", "quantized"}`` dicts for every paged
+    route decision made while tracing under this context."""
+    routes = []
+    _PAGED_ROUTE_SINK.append(routes)
+    try:
+        yield routes
+    finally:
+        _PAGED_ROUTE_SINK.remove(routes)
+
+
+def _note_paged_route(impl, reason, quantized):
+    for routes in _PAGED_ROUTE_SINK:
+        routes.append({"impl": impl, "reason": reason,
+                       "quantized": bool(quantized)})
+
+
+def _paged_attention_xla(q, k, v, valid, scale=None, k_scale=None,
+                         v_scale=None):
+    """The XLA paged-attention lowering — the pre-kernel-tier op
+    sequence, byte-identical to what every release before the paged
+    kernel traced.  The math mirrors ``parallel.ring_attention
+    ._block_attn``: masked scores pin to the same ``-1e30`` floor, so
+    masked keys contribute an EXACT ``0.0`` to both the softmax
+    denominator and the value sum.  With ``k_scale``/``v_scale`` the
+    int8 pages dequantize up front (one f32 broadcast multiply), the
+    same f32 operands the kernel reconstructs in VMEM."""
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     s = jnp.where(valid[:, None, None, :], s, -1e30)
@@ -179,6 +247,71 @@ def paged_attention(q, k, v, valid, scale=None):
     l = jnp.sum(e, axis=-1, keepdims=True)
     o = jnp.einsum("bhqk,bhkd->bhqd", e.astype(v.dtype), v)
     return (o / l.astype(o.dtype)).astype(q.dtype)
+
+
+def paged_attention(q, k, v, valid, scale=None, k_scale=None,
+                    v_scale=None):
+    """Decode-step attention over a page-gathered context window.
+
+    ``q`` is the single new query ``[B, H, 1, Dh]``; ``k``/``v`` are the
+    context gathered through a request's page table ``[B, H, K, Dh]``
+    (``K = page_table_width * page_size``, so slots past the sequence's
+    true length hold stale or clipped-sentinel data); ``valid`` ``[B, K]``
+    masks exactly the real positions.  With ``k_scale``/``v_scale``
+    (``[B, H, K]`` f32 per-row scales from ``mx.quantization
+    .quantize_rows``) the K/V operands are int8 KV pages and dequantize
+    in the consumer — inside the kernel's VMEM pass, or up front on the
+    XLA path.  Both lowerings pin masked scores to the ``-1e30`` floor
+    of ``parallel.ring_attention._block_attn`` and track an unpadded
+    forward bitwise-closely enough for greedy token parity
+    (tools/check_generation.py enforces it).
+
+    Routing (mirrors :func:`attention`): tier off → the plain XLA
+    lowering, traced identically to the pre-kernel-tier program.  Tier
+    on → the Pallas paged kernel when the shape qualifies
+    (``kernels.paged_attention`` counter; the tuned ``block_bh`` applies
+    when mx.perf.autotune has a "paged" winner for this site), the XLA
+    lowering when the shape can't take the kernel
+    (``kernels.paged_fallback``) or when the default-source gate
+    measured the kernel slower / not bit-close
+    (``kernels.gated_fallback``).  The decision and its reason land on a
+    ``kernels.paged`` tracing span and, under
+    :func:`record_paged_routes`, in the export route sink."""
+    from . import tracing as _tracing
+    quant = k_scale is not None
+    if enabled():
+        q = jnp.asarray(q)
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        reason = paged_unsupported_reason(q, k, v, valid, quantized=quant)
+        if reason is None:
+            from . import autotune as _autotune
+            pick = _autotune.paged_pick(tuple(q.shape), tuple(k.shape),
+                                        str(q.dtype), quant, scale)
+            if pick is None or pick.get("impl") == "paged":
+                _telemetry.counter("kernels.paged_attention").inc()
+                _note_paged_route("paged", None, quant)
+                bb = pick.get("block_bh") if pick else None
+                with _tracing.span("kernels.paged", cat="kernels",
+                                   impl="paged", quantized=quant):
+                    return pallas_paged_attention(
+                        q, k, v, valid, scale=scale, k_scale=k_scale,
+                        v_scale=v_scale,
+                        block_bh=int(bb) if bb else None)
+            # the measured gate lost (or the platform statically can't
+            # win): the XLA lowering IS the winner for this site
+            reason = pick.get("reason") or "autotune gate: xla won"
+            _telemetry.counter("kernels.gated_fallback").inc()
+        else:
+            _telemetry.counter("kernels.paged_fallback").inc()
+        with _tracing.span("kernels.paged", cat="kernels", impl="xla",
+                           reason=reason, quantized=quant):
+            _note_paged_route("xla", reason, quant)
+            return _paged_attention_xla(q, k, v, valid, scale=scale,
+                                        k_scale=k_scale, v_scale=v_scale)
+    _note_paged_route("xla", "tier off", quant)
+    return _paged_attention_xla(q, k, v, valid, scale=scale,
+                                k_scale=k_scale, v_scale=v_scale)
 
 
 def measure(key, fn, *args):

@@ -225,32 +225,92 @@ class TransformerLM:
     # clip to a real page whose rows the position mask then zeroes out —
     # padded table entries and inactive decode slots are branch-free.
 
-    def kv_spec(self):
+    def kv_spec(self, quantized=False):
         """Static description of one model's page pool — what deploy.py
-        stamps into the v4 meta so a server can allocate the pool without
-        reconstructing the model."""
+        stamps into the v4/v5 meta so a server can allocate the pool
+        without reconstructing the model.  ``quantized`` describes int8
+        KV pages: int8 payload pools plus per-(slot, head) f32 scale
+        pools, HALF the HBM per cached token."""
         cfg = self.cfg
-        return {"num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
-                "head_dim": cfg.head_dim, "dtype": jnp.dtype(cfg.dtype).name}
+        spec = {"num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+                "head_dim": cfg.head_dim,
+                "dtype": jnp.dtype(cfg.dtype).name}
+        if quantized:
+            spec["quantized"] = True
+        return spec
 
-    def init_kv_pages(self, num_pages, page_size):
+    def init_kv_pages(self, num_pages, page_size, quantized=False):
         """Zeroed device page pool: {"k","v"} of
-        [L, num_pages, page_size, H, Dh] in the model dtype."""
+        [L, num_pages, page_size, H, Dh] in the model dtype; with
+        ``quantized`` the payload is int8 and per-row scales ride along
+        as {"k_scale","v_scale"} of [L, num_pages, page_size, H] f32."""
         cfg = self.cfg
         shape = (cfg.num_layers, int(num_pages), int(page_size),
                  cfg.num_heads, cfg.head_dim)
+        if quantized:
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                    "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
         return {"k": jnp.zeros(shape, cfg.dtype),
                 "v": jnp.zeros(shape, cfg.dtype)}
 
     def _logits_last(self, params, x):
         """Final norm + tied-embedding readout for one position per row:
-        x [B, D] -> greedy next-token ids [B] int32."""
+        x [B, D] -> logits [B, V] f32."""
         x = _norm(x, params["final_norm"])
-        logits = jnp.einsum("bd,vd->bv", x, params["embed"],
-                            preferred_element_type=jnp.float32)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.einsum("bd,vd->bv", x, params["embed"],
+                          preferred_element_type=jnp.float32)
 
-    def prefill(self, params, kv, tokens, lengths, page_table, page_size):
+    def _sample_last(self, params, x, positions, sample):
+        """Readout + next-token choice for one position per row.
+
+        ``sample`` None = greedy (argmax — the bitwise oracle contract).
+        Otherwise a dict of per-row arrays: ``temperature`` [B] f32
+        (0 = greedy for that row), ``top_k`` [B] i32 (0 = off),
+        ``top_p`` [B] f32 (1 = off), ``key`` [B, 2] uint32 raw PRNG key
+        data.  The row key is folded with the POSITION OF THE TOKEN
+        BEING SAMPLED, so a fixed request seed yields one deterministic
+        stream regardless of batch composition or dispatch order — the
+        sampling-determinism contract of tools/check_generation.py.
+        Sampling is Gumbel-max over the temperature-scaled, top-k/top-p
+        masked logits; rows with temperature 0 take the UNSCALED argmax,
+        bitwise the greedy readout.  Returns ``(ids [B] i32,
+        logits [B, V] f32)`` — raw logits, for the int8 drift gate."""
+        logits = self._logits_last(params, x)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sample is None:
+            return greedy, logits
+        temp = sample["temperature"].astype(jnp.float32)        # [B]
+        top_k = sample["top_k"].astype(jnp.int32)               # [B]
+        top_p = sample["top_p"].astype(jnp.float32)             # [B]
+        keys = sample["key"].astype(jnp.uint32)                 # [B, 2]
+        V = logits.shape[-1]
+        safe_t = jnp.where(temp > 0, temp, 1.0)
+        scaled = logits / safe_t[:, None]
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        # top-k: the kth-largest scaled logit is the row threshold
+        k_idx = jnp.clip(top_k - 1, 0, V - 1)
+        kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+        keep = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
+        # top-p (nucleus): keep the smallest sorted prefix whose
+        # probability mass reaches p — token i survives while the mass
+        # BEFORE it is < p, so the first token always survives
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        in_nucleus = (csum - probs) < top_p[:, None]
+        thr = jnp.min(jnp.where(in_nucleus, sorted_desc, jnp.inf),
+                      axis=-1, keepdims=True)
+        keep &= jnp.where((top_p < 1.0)[:, None], scaled >= thr, True)
+        masked = jnp.where(keep, scaled, -jnp.inf)
+        gum = jax.vmap(lambda kr, pos: jax.random.gumbel(
+            jax.random.fold_in(kr, pos), (V,), jnp.float32))(
+                keys, positions.astype(jnp.uint32))
+        choice = jnp.argmax(masked + gum, axis=-1).astype(jnp.int32)
+        return jnp.where(temp > 0, choice, greedy), logits
+
+    def prefill(self, params, kv, tokens, lengths, page_table, page_size,
+                sample=None, return_logits=False):
         """Process whole prompts and seed the paged cache.
 
         tokens [B, S] int32 (rows padded past ``lengths`` with anything),
@@ -259,12 +319,18 @@ class TransformerLM:
         attention seen by position ``lengths-1`` is exactly ``apply()``'s,
         so the returned greedy next token matches the eager oracle —
         while every layer's K/V stream is scattered into the page pool.
-        Returns ``(new_kv, next_token[B] int32)``.
+        An int8 pool (``"k_scale" in kv``) quantizes each row on the way
+        into the pages; prefill attention itself reads the full-precision
+        stream, so the FIRST generated token is untouched by KV
+        quantization.  ``sample`` (see :meth:`_sample_last`) draws the
+        next token; None = greedy.  Returns ``(new_kv, next_token[B]
+        int32)``, plus the next-token logits with ``return_logits``.
         """
         cfg = self.cfg
         B, S = tokens.shape
         psz = int(page_size)
         pool = kv["k"].shape[1]
+        quant = "k_scale" in kv
         x = (params["embed"][tokens]
              + params["pos_embed"][:S][None]).astype(cfg.dtype)
         x = self._constrain(x, self._dp, self._sp, None)
@@ -277,30 +343,52 @@ class TransformerLM:
         slots = jnp.broadcast_to(iota % psz, (B, S))
 
         def body(carry, xs):
-            lp, kl, vl = xs
+            if quant:
+                lp, kl, vl, ksl, vsl = xs
+            else:
+                lp, kl, vl = xs
             new = {}
 
             def sink(k, v):
                 # [B,H,S,Dh] -> [B,S,H,Dh] page-slot scatter
-                new["k"] = kl.at[pages, slots].set(
-                    jnp.transpose(k, (0, 2, 1, 3)).astype(kl.dtype),
-                    mode="drop")
-                new["v"] = vl.at[pages, slots].set(
-                    jnp.transpose(v, (0, 2, 1, 3)).astype(vl.dtype),
-                    mode="drop")
+                kt = jnp.transpose(k, (0, 2, 1, 3))
+                vt = jnp.transpose(v, (0, 2, 1, 3))
+                if quant:
+                    from .. import quantization as _quant
+                    kq, ks = _quant.quantize_rows(kt)
+                    vq, vs = _quant.quantize_rows(vt)
+                    new["k"] = kl.at[pages, slots].set(kq, mode="drop")
+                    new["v"] = vl.at[pages, slots].set(vq, mode="drop")
+                    new["ks"] = ksl.at[pages, slots].set(ks, mode="drop")
+                    new["vs"] = vsl.at[pages, slots].set(vs, mode="drop")
+                else:
+                    new["k"] = kl.at[pages, slots].set(
+                        kt.astype(kl.dtype), mode="drop")
+                    new["v"] = vl.at[pages, slots].set(
+                        vt.astype(vl.dtype), mode="drop")
 
             out = self._layer(carry, lp, kv_sink=sink)
+            if quant:
+                return out, (new["k"], new["v"], new["ks"], new["vs"])
             return out, (new["k"], new["v"])
 
-        x, (nk, nv) = _runtime.scan_stack(
-            body, x, (params["layers"], kv["k"], kv["v"]))
+        xs = (params["layers"], kv["k"], kv["v"])
+        if quant:
+            xs += (kv["k_scale"], kv["v_scale"])
+        x, ys = _runtime.scan_stack(body, x, xs)
+        nkv = {"k": ys[0], "v": ys[1]}
+        if quant:
+            nkv["k_scale"], nkv["v_scale"] = ys[2], ys[3]
         last = jnp.take_along_axis(
             x, jnp.maximum(lengths - 1, 0)[:, None, None]
             .astype(jnp.int32), axis=1)[:, 0]                 # [B, D]
-        return {"k": nk, "v": nv}, self._logits_last(params, last)
+        ids, logits = self._sample_last(params, last, lengths, sample)
+        if return_logits:
+            return nkv, ids, logits
+        return nkv, ids
 
     def decode_step(self, params, kv, token_ids, positions, page_table,
-                    page_size):
+                    page_size, sample=None, return_logits=False):
         """One generation iteration for a whole decode batch.
 
         token_ids [B] int32 (the token to append), positions [B] int32
@@ -309,13 +397,19 @@ class TransformerLM:
         table over positions <= its own, and returns
         ``(new_kv, next_token[B] int32)``.  Inactive slots pass the
         sentinel page everywhere: their write drops and their output is
-        garbage the scheduler ignores.
+        garbage the scheduler ignores.  With an int8 pool the appended
+        row quantizes into the pages and the gathered context carries its
+        per-row scales into ``kernels.paged_attention``, which
+        dequantizes in the consumer (inside the Pallas kernel's VMEM
+        pass on the kernel route).  ``sample``/``return_logits`` as in
+        :meth:`prefill`.
         """
         cfg = self.cfg
         B = token_ids.shape[0]
         W = page_table.shape[1]
         psz = int(page_size)
         H, Dh = cfg.num_heads, cfg.head_dim
+        quant = "k_scale" in kv
         x = (params["embed"][token_ids]
              + params["pos_embed"][positions]).astype(cfg.dtype)[:, None]
         page = jnp.take_along_axis(
@@ -325,26 +419,51 @@ class TransformerLM:
             <= positions[:, None]                             # [B, K]
 
         def body(carry, xs):
-            lp, kl, vl = xs
+            if quant:
+                lp, kl, vl, ksl, vsl = xs
+            else:
+                lp, kl, vl = xs
             q, k, v = self._qkv(carry, lp)                    # [B,H,1,Dh]
-            kl = kl.at[page, slot].set(
-                jnp.transpose(k, (0, 2, 1, 3)).astype(kl.dtype),
-                mode="drop")
-            vl = vl.at[page, slot].set(
-                jnp.transpose(v, (0, 2, 1, 3)).astype(vl.dtype),
-                mode="drop")
+            kt = jnp.transpose(k, (0, 2, 1, 3))               # [B,1,H,Dh]
+            vt = jnp.transpose(v, (0, 2, 1, 3))
+            scales = {}
+            if quant:
+                from .. import quantization as _quant
+                kt, ks = _quant.quantize_rows(kt)
+                vt, vs = _quant.quantize_rows(vt)
+                ksl = ksl.at[page, slot].set(ks, mode="drop")
+                vsl = vsl.at[page, slot].set(vs, mode="drop")
+                # gathered per-row scales, [B, K] -> [B, H, K]
+                scales["k_scale"] = jnp.transpose(
+                    ksl[page_table].reshape(B, W * psz, H), (0, 2, 1))
+                scales["v_scale"] = jnp.transpose(
+                    vsl[page_table].reshape(B, W * psz, H), (0, 2, 1))
+            kl = kl.at[page, slot].set(kt.astype(kl.dtype), mode="drop")
+            vl = vl.at[page, slot].set(vt.astype(vl.dtype), mode="drop")
             # context through the page table (sentinel entries clip to a
             # real page; `valid` masks them out of the softmax exactly)
             kc = jnp.transpose(
                 kl[page_table].reshape(B, W * psz, H, Dh), (0, 2, 1, 3))
             vc = jnp.transpose(
                 vl[page_table].reshape(B, W * psz, H, Dh), (0, 2, 1, 3))
-            o = _kernels.paged_attention(q, kc, vc, valid)
-            return self._attn_mlp(carry, o, lp), (kl, vl)
+            o = _kernels.paged_attention(q, kc, vc, valid, **scales)
+            out = self._attn_mlp(carry, o, lp)
+            if quant:
+                return out, (kl, vl, ksl, vsl)
+            return out, (kl, vl)
 
-        x, (nk, nv) = _runtime.scan_stack(
-            body, x, (params["layers"], kv["k"], kv["v"]))
-        return {"k": nk, "v": nv}, self._logits_last(params, x[:, 0])
+        xs = (params["layers"], kv["k"], kv["v"])
+        if quant:
+            xs += (kv["k_scale"], kv["v_scale"])
+        x, ys = _runtime.scan_stack(body, x, xs)
+        nkv = {"k": ys[0], "v": ys[1]}
+        if quant:
+            nkv["k_scale"], nkv["v_scale"] = ys[2], ys[3]
+        ids, logits = self._sample_last(params, x[:, 0], positions + 1,
+                                        sample)
+        if return_logits:
+            return nkv, ids, logits
+        return nkv, ids
 
     def greedy_decode(self, params, prompt, max_new_tokens, eos_id=None):
         """Cache-free greedy-decode reference: a FULL re-forward of the

@@ -33,6 +33,7 @@ from .registry import register
 
 __all__ = ["pallas_row_softmax", "pallas_scale_bias_relu",
            "pallas_flash_attention", "flash_attention",
+           "pallas_paged_attention",
            "fused_sgd_step", "fused_adam_step"]
 
 _NEG = -1e30
@@ -379,6 +380,115 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     differentiable; see ``flash_attention`` for the kernel story."""
     return flash_attention(q, k, v, causal=causal, scale=scale,
                            block_q=block_q)
+
+
+# ------------------------------------------------------- paged attention
+def _paged_attn_kernel(scale, quant, *refs):
+    """One row block of single-query paged attention: ``rows`` (batch,
+    head) pairs, each attending its page-gathered context of K slots.
+
+    This is the online-softmax attend in its degenerate one-block form —
+    a decode query is a single row, so the whole gathered context of a
+    row block lives in VMEM and the stable (max, sum) accumulation
+    happens on chip in f32 in one pass; no partial-block merge is ever
+    needed.  Masked slots pin to the ``-1e30`` floor of
+    ``parallel.ring_attention._block_attn``, so ``exp`` underflows to an
+    EXACT 0.0 in both the denominator and the value sum — the bitwise
+    contract the greedy-parity oracle rides on.  With ``quant`` the K/V
+    blocks arrive int8 and dequantize INSIDE the kernel (one f32
+    broadcast multiply per row), so HBM traffic stays at the int8 byte
+    count — the entire point of int8 KV pages."""
+    if quant:
+        q_ref, k_ref, v_ref, valid_ref, ks_ref, vs_ref, o_ref = refs
+    else:
+        q_ref, k_ref, v_ref, valid_ref, o_ref = refs
+    q = q_ref[:]                                    # [rows, D]
+    k = k_ref[:]                                    # [rows, K, D]
+    v = v_ref[:]
+    if quant:
+        k = k.astype(jnp.float32) * ks_ref[:][..., None]
+        v = v.astype(jnp.float32) * vs_ref[:][..., None]
+    s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid_ref[:], s, _NEG)            # [rows, K]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    acc = jax.lax.dot_general(e.astype(v.dtype), v,
+                              (((1,), (1,)), ((0,), (0,))))
+    o_ref[:] = (acc / l.astype(acc.dtype)).astype(o_ref.dtype)
+
+
+def pallas_paged_attention(q, k, v, valid, scale=None, k_scale=None,
+                           v_scale=None, block_bh=None):
+    """Paged-attention decode kernel: one query row per (batch, head)
+    against its page-gathered context.
+
+    q [B, H, 1, Dh]; k/v [B, H, K, Dh] gathered through a page table
+    (slots past the true length hold stale or clipped-sentinel data);
+    valid [B, K] masks exactly the real positions.  With
+    ``k_scale``/``v_scale`` ([B, H, K] f32 per-row scales from
+    ``mx.quantization.quantize_rows``) the K/V operands are int8 pages
+    and dequantize inside the kernel.
+
+    The grid walks blocks of ``block_bh`` (batch, head) rows (None =
+    derive from the VMEM budget); each step holds its rows' full
+    gathered K/V in VMEM.  The math is row-independent, so EVERY legal
+    block size computes identical bits — which is why the
+    mx.perf.autotune "paged" search can tune it freely under the bitwise
+    greedy-parity contract.  Routing/fallback policy lives in
+    ``mx.kernels.paged_attention``."""
+    from jax.experimental import pallas as pl
+    from ..rtc import interpret_mode
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    B, H, Sq, D = q.shape
+    if Sq != 1:
+        raise ValueError("paged attention takes one query row per "
+                         "sequence, got Sq=%d" % Sq)
+    K = k.shape[2]
+    if v.shape != k.shape:
+        raise ValueError("k and v shapes differ: %s vs %s"
+                         % (k.shape, v.shape))
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    quant = k_scale is not None
+    BH = B * H
+    qf = q.reshape(BH, D)
+    kf = k.reshape(BH, K, D)
+    vf = v.reshape(BH, K, D)
+    validf = jnp.broadcast_to(valid[:, None, :], (B, H, K)).reshape(BH, K)
+    # per-row VMEM: the gathered K/V dominate; scales/mask/q are noise
+    row_bytes = 2 * K * D * k.dtype.itemsize \
+        + K * (1 + 8 * int(quant)) + D * (q.dtype.itemsize + 4)
+    if block_bh is None:
+        rows = _row_block(BH, row_bytes)
+    else:
+        rows = _row_block(BH, 1, budget=min(int(block_bh), BH))
+    if rows == 1 and BH > 1:
+        # XLA lowers the degenerate one-row dot_general through a
+        # different reduction than the multi-row form (last-ulp drift),
+        # which would break the bitwise greedy-parity contract — snap up
+        # to the smallest real divisor instead.
+        rows = next(r for r in range(2, BH + 1) if BH % r == 0)
+    operands = [qf, kf, vf, validf]
+    in_specs = [pl.BlockSpec((rows, D), lambda i: (i, 0)),
+                pl.BlockSpec((rows, K, D), lambda i: (i, 0, 0)),
+                pl.BlockSpec((rows, K, D), lambda i: (i, 0, 0)),
+                pl.BlockSpec((rows, K), lambda i: (i, 0))]
+    if quant:
+        operands += [jnp.asarray(k_scale, jnp.float32).reshape(BH, K),
+                     jnp.asarray(v_scale, jnp.float32).reshape(BH, K)]
+        in_specs += [pl.BlockSpec((rows, K), lambda i: (i, 0)),
+                     pl.BlockSpec((rows, K), lambda i: (i, 0))]
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale, quant),
+        out_shape=jax.ShapeDtypeStruct((BH, D), q.dtype),
+        grid=(BH // rows,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rows, D), lambda i: (i, 0)),
+        interpret=interpret_mode())(*operands)
+    return out.reshape(B, H, 1, D)
 
 
 # ------------------------------------------- fused optimizer+cast epilogue

@@ -68,6 +68,7 @@ from . import telemetry as _telemetry
 
 __all__ = ["Calibration", "QuantizationError", "calibrate",
            "export_quantized", "quantized_error", "load_quantized",
+           "quantize_rows", "dequantize_rows",
            "QUANTIZABLE_OPS", "SCALE_SUFFIX"]
 
 #: op types the recolor transform understands (the matmul-heavy set whose
@@ -115,6 +116,30 @@ def _to_int8_per_channel(w, channel_axis=0):
     s = 127.0 / jnp.maximum(amax, 1e-12)
     q = jnp.clip(jnp.round(w * s), -127, 127)
     return q.astype(jnp.int8), s
+
+
+def quantize_rows(x):
+    """Symmetric per-ROW int8 over the last axis: returns
+    ``(q int8, scale f32 without the last axis)`` with
+    ``q.astype(f32) * scale[..., None] ~= x``.
+
+    This is the KV-page quantizer (docs/SERVING.md "int8 KV pages"): one
+    scale per (position, head) row of a page, the exact per-channel
+    discipline the v3 weight path uses (``_to_int8_per_channel``) turned
+    sideways — the channel here is the token's head row, because head
+    magnitudes differ while the Dh lanes within one head do not.  Scale
+    is ``amax/127`` (never ``127/amax``) so the dequant inside the paged
+    gather is a single broadcast multiply."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows`: ``q int8 * scale -> dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def quantize_weight_host(w):

@@ -484,8 +484,10 @@ class Server:
             if quantized:
                 raise ServingError(
                     "model %r: generate=True with quantized=True is not "
-                    "supported — v4 generation artifacts are fp-typed"
-                    % (name,))
+                    "supported — KV quantization for generation is baked "
+                    "at EXPORT time (export_generation(..., "
+                    "kv_quantized=True), int8 KV pages), not applied at "
+                    "register" % (name,))
             return self._register_generation(name, prefix)
         predictor = _deploy.StableHLOPredictor(prefix, quantized=quantized)
         if predictor._params is None:
@@ -976,11 +978,16 @@ class Server:
 
     # -------------------------------------------------------- generation
     def submit_generate(self, name, prompt, max_new_tokens, eos_id=None,
-                        deadline_ms=None):
+                        deadline_ms=None, temperature=0.0, top_k=0,
+                        top_p=1.0, seed=None):
         """Enqueue one prompt on generation model ``name``; returns a
         Future resolving to the generated token ids (np.int32, EOS
-        included when hit) — bitwise the eager ``greedy_decode`` stream
-        regardless of co-scheduled traffic.
+        included when hit).  With ``temperature`` 0 (the default) that
+        is bitwise the eager ``greedy_decode`` stream regardless of
+        co-scheduled traffic; ``temperature`` > 0 samples with optional
+        ``top_k`` / ``top_p`` truncation under a per-request ``seed``
+        (sampling-enabled v5 artifacts only — fresh entropy when the
+        seed is None, a fixed seed replays one deterministic stream).
 
         The request joins the model's per-iteration scheduler: it
         prefills into a free decode slot as soon as the KV page pool
@@ -994,14 +1001,18 @@ class Server:
         with _tracing.span("serving.submit", cat="serving", model=name):
             return self._engine(name).submit(
                 prompt, max_new_tokens, eos_id=eos_id,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed)
 
     def generate(self, name, prompt, max_new_tokens, eos_id=None,
-                 timeout=None, deadline_ms=None):
+                 timeout=None, deadline_ms=None, temperature=0.0,
+                 top_k=0, top_p=1.0, seed=None):
         """Synchronous convenience:
         ``submit_generate(...).result(timeout)``."""
         fut = self.submit_generate(name, prompt, max_new_tokens,
-                                   eos_id=eos_id, deadline_ms=deadline_ms)
+                                   eos_id=eos_id, deadline_ms=deadline_ms,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, seed=seed)
         try:
             return fut.result(timeout)
         except _FutureTimeout:

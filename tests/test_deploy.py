@@ -233,7 +233,7 @@ def gen_artifact(tmp_path_factory):
     return _export_tiny_generation(tmp_path_factory.mktemp("v4"))
 
 
-def _export_tiny_generation(tmp_path):
+def _export_tiny_generation(tmp_path, **export_kwargs):
     import jax.numpy as jnp
     from mxnet_tpu.models.transformer import (TransformerLM,
                                               TransformerLMConfig)
@@ -258,7 +258,8 @@ def _export_tiny_generation(tmp_path):
     }
     prefix = str(tmp_path / "gen")
     deploy.export_generation(model, params, prefix, page_size=4,
-                             max_context=8, prompt_buckets=(4, 8))
+                             max_context=8, prompt_buckets=(4, 8),
+                             **export_kwargs)
     return prefix
 
 
@@ -290,6 +291,47 @@ def test_one_shot_artifact_refuses_generator_load(tmp_path):
     pred = deploy.load_model(prefix)  # backward-compat half
     assert pred.format_version == 2
     assert pred.predict(x).shape == (2, 4)
+
+
+def test_v5_sampling_artifact_meta_and_loader(tmp_path):
+    """The v5 (sampling + int8 KV + concrete decode batch) export lands
+    every new meta field, bakes the per-width paged-kernel routing
+    verdict, and the loader surfaces them typed; a fixed seed replays
+    ONE sampled stream offline."""
+    prefix = _export_tiny_generation(
+        tmp_path, sampling=True, kv_quantized=True, decode_batch=2)
+    with open(prefix + "-meta.json") as f:
+        meta = json.load(f)
+    assert meta["format_version"] == 5
+    assert meta["sampling"] is True
+    assert meta["kv"]["quantized"] is True
+    assert meta["decode_batch"] == 2
+    assert set(meta["paged"]) == {"1", "2"}
+    assert all(r["impl"] in ("paged", "xla")
+               for r in meta["paged"].values())
+    pred = deploy.load_generator(prefix)
+    assert pred.format_version == 5
+    assert pred.sampling and pred.kv_quantized
+    assert pred.decode_batch == 2
+    kv = pred.make_kv(4)
+    assert len(kv) == 4                       # k, v, k_scale, v_scale
+    assert str(kv[0].dtype) == "int8"
+    assert str(kv[2].dtype) == "float32"
+    p = np.asarray([1, 2, 3], np.int32)
+    assert len(pred.generate(p, 3)) == 3      # greedy default works
+    s1 = pred.generate(p, 3, temperature=3.0, seed=7)
+    s2 = pred.generate(p, 3, temperature=3.0, seed=7)
+    assert np.array_equal(s1, s2)
+
+
+def test_v4_artifact_refuses_sampling_args(gen_artifact):
+    """Greedy-only v4 artifacts reject temperature > 0 with a pointer
+    at the v5 re-export, instead of silently decoding greedy."""
+    pred = deploy.load_generator(gen_artifact)
+    assert pred.sampling is False and pred.kv_quantized is False
+    assert pred.make_kv(4)[0] is not None and len(pred.make_kv(4)) == 2
+    with pytest.raises(ValueError, match="sampling"):
+        pred.generate(np.asarray([1, 2], np.int32), 2, temperature=0.5)
 
 
 def test_future_format_rejected_by_generator(gen_artifact):

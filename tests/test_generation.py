@@ -1,9 +1,12 @@
 """mx.serving.generate: token-level continuous batching over a paged KV
 cache — offline GenerationPredictor parity vs the eager greedy oracle,
-engine admission validation, KV knob validation, telemetry-report
-generation table + kv_pool_exhaustion anomaly, and the
-tools/check_generation.py smoke (bitwise streams under mid-flight
-exits/joins + flat compiles + pool exhaustion) as a subprocess.
+engine admission validation, KV knob validation, shared-prefix page
+refcount lifecycle (last-reader free, mid-flight sharer exit,
+page-granular copy-on-write, no double-counted pages), sampling
+admission gates, telemetry-report generation table + kv_pool_exhaustion
+anomaly, and the tools/check_generation.py smoke (bitwise streams under
+mid-flight exits/joins + flat compiles + pool exhaustion + Pallas paged
+kernel routing + sampling determinism + int8 KV drift) as a subprocess.
 """
 import json
 import os
@@ -116,6 +119,137 @@ def test_kv_knobs_registered_and_validated():
         config.set(knob, default)  # restore (no unset API)
 
 
+# ------------------------------------------------- shared-prefix pages
+
+def _share_req(prompt, max_new, psz=PAGE):
+    """Build a _GenRequest exactly the way submit() does when
+    serving.shared_prefix is on (full-page content keys)."""
+    import math
+    prompt = np.asarray(prompt, np.int32)
+    plen = int(prompt.shape[0])
+    keys = tuple((i, prompt[:(i + 1) * psz].tobytes())
+                 for i in range(plen // psz))
+    need = math.ceil((plen + max_new) / psz)
+    return generation._GenRequest(prompt, max_new, None, 0.0, need,
+                                  prefix_keys=keys)
+
+
+def test_prefix_refcount_lifecycle(artifact):
+    """Admission maps equal full-page prefixes to the SAME physical
+    pages (kv_pages_in_use counts them once), divergent pages go
+    copy-on-write private, and pages free only with the LAST reader."""
+    prefix, _, _ = artifact
+    pred = deploy.load_generator(prefix)
+    eng = generation.GenerationEngine("rc", pred, num_pages=8,
+                                      decode_slots=4)
+    base = np.arange(8, dtype=np.int32)          # 2 full PAGE=4 pages
+    fork = np.concatenate([base[:4], base[4:] + 9])  # diverges page 1
+    ra, rb = _share_req(base, 3), _share_req(base, 3)   # need 3 each
+    rc_ = _share_req(fork, 3)
+    now = 0.0
+    with eng._cond:
+        eng._queue.extend([ra, rb, rc_])
+        admitted = eng._admit_locked(now)
+        assert admitted == [ra, rb, rc_]
+        sa, sb, sc = [s for s in eng._slots if s is not None]
+        # a and b share both prefix pages; c shares only page 0
+        assert sa.pages[:2] == sb.pages[:2]
+        assert sc.pages[0] == sa.pages[0]
+        assert sc.pages[1] != sa.pages[1]       # copy-on-write page
+        assert eng._prefix[ra.prefix_keys[0]][1] == 3
+        assert eng._prefix[ra.prefix_keys[1]][1] == 2
+        # physical accounting: 2 shared + 1 cow + 3 private = 6 pages
+        assert len(eng._free) == 2
+        # b exits mid-flight: shared pages survive for a, private frees
+        eng._slots[eng._slots.index(sb)] = None
+        eng._release_pages_locked(sb)
+        assert len(eng._free) == 3
+        assert eng._prefix[ra.prefix_keys[0]][1] == 2
+        # c exits: its cow page was its LAST reader — freed with it
+        eng._slots[eng._slots.index(sc)] = None
+        eng._release_pages_locked(sc)
+        assert len(eng._free) == 5
+        assert rc_.prefix_keys[1] not in eng._prefix
+        # a exits last: every page returns, the map drains
+        eng._slots[eng._slots.index(sa)] = None
+        eng._release_pages_locked(sa)
+        assert len(eng._free) == 8
+        assert eng._prefix == {}
+
+
+def test_prefix_stall_accounts_for_shared_pages(artifact):
+    """A request whose prefix is already resident admits even when the
+    free list alone could not cover it — sharing IS capacity."""
+    prefix, _, _ = artifact
+    pred = deploy.load_generator(prefix)
+    eng = generation.GenerationEngine("cap", pred, num_pages=4,
+                                      decode_slots=4)
+    base = np.arange(8, dtype=np.int32)
+    r1, r2 = _share_req(base, 3), _share_req(base, 3)  # need 3 each
+    with eng._cond:
+        eng._queue.extend([r1, r2])
+        admitted = eng._admit_locked(0.0)
+        # without sharing r2 would stall (3 needed, 1 free) — with it
+        # r2 only draws its private page
+        assert admitted == [r1, r2]
+        assert len(eng._free) == 0
+
+
+def test_shared_prefix_end_to_end_bitwise(artifact):
+    """Concurrent sharers of one system prefix: streams stay bitwise
+    equal to the eager oracle while pages are physically shared, one
+    sharer exits mid-flight, and the pool drains clean."""
+    prefix, model, params = artifact
+    pred = deploy.load_generator(prefix)
+    eng = generation.GenerationEngine(
+        "share", pred, num_pages=16, decode_slots=4, max_pending=32,
+        default_deadline_ms=0)
+    eng.start()
+    try:
+        sysp = np.asarray([3, 5, 7, 2], np.int32)       # one full page
+        prompts = [np.concatenate([sysp, np.asarray(t, np.int32)])
+                   for t in ([7], [9], [7])]
+        budgets = [6, 2, 6]   # the middle sharer EXITS mid-flight
+        oracle = [model.greedy_decode(params, p, n)
+                  for p, n in zip(prompts, budgets)]
+        h0 = telemetry.counter("serving.prefix_hits").value
+        futs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+        outs = [f.result(timeout=60) for f in futs]
+        for got, want in zip(outs, oracle):
+            assert np.array_equal(got, want)
+        assert telemetry.counter("serving.prefix_hits").value - h0 >= 1
+        st = eng.stats()
+        assert st["kv_pages_free"] == 16
+        assert st["prefix_entries"] == 0
+    finally:
+        eng.stop()
+
+
+def test_shared_prefix_knob_disables_sharing(artifact):
+    prefix, _, _ = artifact
+    pred = deploy.load_generator(prefix)
+    assert "serving.shared_prefix" in config.knobs()
+    config.set("serving.shared_prefix", False)
+    try:
+        eng = generation.GenerationEngine("noshare", pred, num_pages=8)
+        assert eng._share is False
+    finally:
+        config.set("serving.shared_prefix", True)
+    assert generation.GenerationEngine(
+        "reshare", pred, num_pages=8)._share is True
+
+
+def test_sampling_requires_v5_artifact(artifact):
+    """temperature > 0 against a v4 (greedy-only) artifact fails typed
+    at submit — before queueing, before the engine even starts."""
+    prefix, _, _ = artifact
+    pred = deploy.load_generator(prefix)
+    assert pred.sampling is False
+    eng = generation.GenerationEngine("v4s", pred, num_pages=8)
+    with pytest.raises(ValueError, match="sampling-enabled"):
+        eng.submit(np.arange(3, dtype=np.int32), 2, temperature=0.7)
+
+
 # ------------------------------------------------ telemetry report table
 
 def _gen_rec(model="g", ttft=4.0, wall=40.0, new=8, waited=False):
@@ -162,7 +296,7 @@ def test_check_generation_smoke():
     proc = subprocess.run(
         [sys.executable,
          os.path.join(root, "tools", "check_generation.py")],
-        capture_output=True, text=True, timeout=180,
+        capture_output=True, text=True, timeout=300,
         env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=root)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -172,4 +306,12 @@ def test_check_generation_smoke():
         len(report["compiles"]["prompt_buckets"]) + \
         len(report["compiles"]["decode_widths"])
     assert report["kv_pool"]["exhausted_waits"] > 0
-    assert report["elapsed_s"] < (5.0 if (os.cpu_count() or 1) >= 2 else 10.0), report
+    assert all(impl == "paged"
+               for impl in report["paged_kernel"]["routes"].values())
+    assert report["paged_kernel"]["decode_iterations"] > 0
+    assert report["sampling"]["replay_ok"]
+    assert report["sampling"]["distinct_of_8"] >= 2
+    assert report["int8_kv"]["logit_drift"] <= \
+        report["int8_kv"]["error_budget"]
+    assert report["elapsed_s"] < (40.0 if (os.cpu_count() or 1) >= 2
+                                  else 90.0), report

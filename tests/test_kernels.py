@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import mxnet_tpu as mx
 from mxnet_tpu import config, kernels, profiler, telemetry
 from mxnet_tpu.ops.pallas_kernels import (_row_block, flash_attention,
+                                          pallas_paged_attention,
                                           pallas_row_softmax)
 from mxnet_tpu.parallel.ring_attention import attention as xla_attention
 
@@ -194,6 +195,93 @@ def test_routing_counters_and_fallback():
     assert kernels.flash_unsupported_reason(q, k, v, True) is not None
     config.set("kernels.vmem_budget", VMEM_DEFAULT)
     assert kernels.flash_unsupported_reason(q, k, v, True) is None
+
+
+# --------------------------------------------------- paged decode kernel
+def _paged_case(B=2, H=2, K=16, D=8, seed=7, quant=False):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, 1, D), jnp.float32)
+    lens = np.asarray([K - 5, K][:B])
+    valid = jnp.asarray(np.arange(K)[None, :] < lens[:, None])
+    if quant:
+        k = jnp.asarray(rng.randint(-127, 128, (B, H, K, D)), jnp.int8)
+        v = jnp.asarray(rng.randint(-127, 128, (B, H, K, D)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(1e-3, 2e-2, (B, H, K)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(1e-3, 2e-2, (B, H, K)), jnp.float32)
+        return q, k, v, valid, ks, vs
+    k = jnp.asarray(rng.randn(B, H, K, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, K, D), jnp.float32)
+    return q, k, v, valid, None, None
+
+
+@pytest.mark.parametrize("block_bh", [None, 1, 2, 4])
+def test_paged_kernel_bitwise_vs_xla(block_bh):
+    """The one-query-row online-softmax kernel is BITWISE equal to the
+    static XLA lowering at every legal row block (jit-vs-jit — the only
+    comparison XLA's fusion keeps honest)."""
+    import functools
+    q, k, v, valid, _, _ = _paged_case()
+    got = jax.jit(functools.partial(
+        pallas_paged_attention, block_bh=block_bh))(q, k, v, valid)
+    want = jax.jit(kernels._paged_attention_xla)(q, k, v, valid)
+    assert np.array_equal(np.asarray(got), np.asarray(want)), block_bh
+
+
+def test_paged_kernel_int8_dequant_bitwise():
+    """int8 KV pages dequantize INSIDE the kernel gather — bitwise equal
+    to dequantize-then-XLA, so the quant error budget is the only drift
+    source, never the kernel."""
+    q, k, v, valid, ks, vs = _paged_case(quant=True)
+    got = jax.jit(lambda *a: pallas_paged_attention(
+        a[0], a[1], a[2], a[3], k_scale=a[4], v_scale=a[5]))(
+        q, k, v, valid, ks, vs)
+    want = jax.jit(lambda *a: kernels._paged_attention_xla(
+        a[0], a[1], a[2], a[3], k_scale=a[4], v_scale=a[5]))(
+        q, k, v, valid, ks, vs)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_paged_routing_explicit_vs_default():
+    """Explicit tier-on routes decode through the Pallas kernel (counter
+    + route record); the graduated default on the interpreter backend
+    takes the measured gate's static-XLA fallback — bitwise identical
+    output either way."""
+    q, k, v, valid, _, _ = _paged_case()
+    telemetry.reset()
+    config.set("kernels.enabled", True)       # explicit source
+    with kernels.record_paged_routes() as routes:
+        out_k = jax.jit(lambda *a: kernels.paged_attention(*a))(
+            q, k, v, valid)
+    assert routes and routes[0]["impl"] == "paged"
+    assert telemetry.counter("kernels.paged_attention").value == 1
+    config.unset("kernels.enabled")           # graduated default
+    with kernels.record_paged_routes() as routes2:
+        out_x = jax.jit(lambda *a: kernels.paged_attention(*a))(
+            q, k, v, valid)
+    assert routes2 and routes2[0]["impl"] == "xla"
+    assert telemetry.counter("kernels.paged_attention").value == 1
+    assert np.array_equal(np.asarray(out_k), np.asarray(out_x))
+
+
+def test_paged_unsupported_reasons():
+    q, k, v, valid, _, ks = _paged_case()
+    assert kernels.paged_unsupported_reason(q, k, v, valid) is None
+    # multi-row query: prefill shapes never take the decode kernel
+    q2 = jnp.concatenate([q, q], axis=2)
+    assert "query row" in kernels.paged_unsupported_reason(
+        q2, k, v, valid)
+    # int8 pages without the quantized contract are refused
+    assert kernels.paged_unsupported_reason(
+        q, k.astype(jnp.int8), v, valid) is not None
+    assert kernels.paged_unsupported_reason(
+        q, k.astype(jnp.int8), v.astype(jnp.int8), valid,
+        quantized=True) is None
+    # a kv slice over the VMEM budget is infeasible, typed
+    config.set("kernels.vmem_budget", 64)
+    reason = kernels.paged_unsupported_reason(q, k, v, valid)
+    assert reason is not None and "vmem" in reason.lower()
+    config.set("kernels.vmem_budget", VMEM_DEFAULT)
+    assert kernels.paged_unsupported_reason(q, k, v, valid) is None
 
 
 # ----------------------------------------------------------- row softmax

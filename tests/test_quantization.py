@@ -31,6 +31,28 @@ def _batches(n=3, batch=8, feat=6, seed=0):
             for _ in range(n)]
 
 
+# ----------------------------------------- per-row KV-page quantization
+
+def test_quantize_rows_roundtrip_and_zero_rows():
+    """quantize_rows: per-row symmetric int8 over the LAST axis — one
+    f32 scale per row (the int8 KV page layout), dequant error bounded
+    by half an int8 step, all-zero rows exactly preserved."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 5, 4).astype(np.float32))
+    q, s = quantization.quantize_rows(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert s.dtype == jnp.float32 and s.shape == (2, 5)
+    back = np.asarray(quantization.dequantize_rows(q, s))
+    step = np.asarray(s)[..., None]
+    assert np.all(np.abs(back - np.asarray(x)) <= step * 0.5 + 1e-7)
+    z = jnp.zeros((3, 4), jnp.float32)
+    qz, sz = quantization.quantize_rows(z)
+    assert np.all(np.asarray(qz) == 0)
+    assert np.array_equal(
+        np.asarray(quantization.dequantize_rows(qz, sz)), np.asarray(z))
+
+
 # ------------------------------------------- S1: KL degenerate histograms
 
 def test_kl_threshold_all_zero_histogram_falls_back():
